@@ -1,0 +1,25 @@
+"""bigdl_tpu: a TPU-native distributed deep-learning framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of BigDL v0.1 (Intel's
+Torch-style distributed DL library for Apache Spark; reference surveyed in
+/root/repo/SURVEY.md).  The compute path is jax.numpy / lax under jax.jit
+(XLA plays the role MKL played on Xeon); distribution is expressed as
+shardings over a `jax.sharding.Mesh` with XLA collectives over ICI/DCN
+(playing the role of the reference's FP16 all-reduce over Spark's
+BlockManager, reference parameters/AllReduceParameter.scala:53-228).
+
+Top-level layout (mirrors the reference's layer map, SURVEY.md SS1):
+
+- ``bigdl_tpu.tensor``   -- dtype seam + Torch-verb array helpers  (ref tensor/)
+- ``bigdl_tpu.nn``       -- module system, layer zoo, criterions   (ref nn/)
+- ``bigdl_tpu.optim``    -- optim methods, local/distributed loops (ref optim/)
+- ``bigdl_tpu.parallel`` -- mesh, collectives, sharded parameters  (ref parameters/)
+- ``bigdl_tpu.dataset``  -- DataSet/Transformer input pipeline     (ref dataset/)
+- ``bigdl_tpu.models``   -- model zoo + train/test CLIs            (ref models/)
+- ``bigdl_tpu.utils``    -- Engine, Table, RNG, File, Summary      (ref utils/)
+"""
+
+__version__ = "0.1.0"
+
+from bigdl_tpu.utils.table import Table, T  # noqa: F401
+from bigdl_tpu.utils.engine import Engine  # noqa: F401

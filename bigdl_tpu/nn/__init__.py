@@ -1,0 +1,61 @@
+"""nn: the module system and layer zoo (ref spark/dl/.../nn/, 142 files).
+
+Every public layer/criterion name from the reference's zoo is exported here
+so ``from bigdl_tpu import nn; nn.Linear(...)`` mirrors
+``com.intel.analytics.bigdl.nn.Linear``.
+"""
+from bigdl_tpu.nn.module import Module, Criterion
+from bigdl_tpu.nn.containers import (
+    Container, Sequential, Concat, ConcatTable, ParallelTable, MapTable,
+    Bottle, FlattenTable, SplitTable, JoinTable, MixtureTable, NarrowTable,
+    SelectTable,
+)
+from bigdl_tpu.nn.activations import (
+    ReLU, ReLU6, Tanh, Sigmoid, SoftMax, SoftMin, LogSoftMax, LogSigmoid,
+    SoftPlus, SoftSign, LeakyReLU, ELU, PReLU, RReLU, HardTanh, HardShrink,
+    SoftShrink, TanhShrink, Threshold, Clamp, Power, Square, Sqrt, Log, Exp,
+    Abs,
+)
+from bigdl_tpu.nn.linear import (
+    Linear, Bilinear, MM, MV, DotProduct, Cosine, Euclidean,
+    PairwiseDistance, CosineDistance, LookupTable, Add, AddConstant, Mul,
+    MulConstant, CMul, CAdd, Scale,
+)
+from bigdl_tpu.nn.conv import (
+    SpatialConvolution, SpatialShareConvolution, SpatialDilatedConvolution,
+    SpatialFullConvolution, SpatialConvolutionMap,
+)
+from bigdl_tpu.nn.pooling import SpatialMaxPooling, SpatialAveragePooling, RoiPooling
+from bigdl_tpu.nn.normalization import (
+    BatchNormalization, SpatialBatchNormalization, Normalize,
+    SpatialCrossMapLRN, SpatialSubtractiveNormalization,
+    SpatialDivisiveNormalization, SpatialContrastiveNormalization,
+)
+from bigdl_tpu.nn.shape import (
+    Identity, Echo, Contiguous, Copy, Reshape, InferReshape, View, Squeeze,
+    Unsqueeze, Transpose, Replicate, Padding, SpatialZeroPadding, Narrow,
+    Select, Index, MaskedSelect, Reverse,
+)
+from bigdl_tpu.nn.table_ops import (
+    CAddTable, CSubTable, CMulTable, CDivTable, CMaxTable, CMinTable,
+    Sum, Mean, Max, Min,
+)
+from bigdl_tpu.nn.dropout import Dropout, L1Penalty, GradientReversal
+from bigdl_tpu.nn.detection import Nms, nms
+from bigdl_tpu.nn.recurrent import (
+    Cell, RnnCell, LSTM, GRU, Recurrent, BiRecurrent, TimeDistributed,
+)
+from bigdl_tpu.nn.criterions import (
+    ClassNLLCriterion, CrossEntropyCriterion, MSECriterion, AbsCriterion,
+    BCECriterion, DistKLDivCriterion, SmoothL1Criterion,
+    SmoothL1CriterionWithWeights, MarginCriterion, MarginRankingCriterion,
+    MultiMarginCriterion, MultiLabelMarginCriterion,
+    MultiLabelSoftMarginCriterion, SoftMarginCriterion,
+    HingeEmbeddingCriterion, L1HingeEmbeddingCriterion,
+    CosineEmbeddingCriterion, ClassSimplexCriterion, L1Cost,
+    SoftmaxWithCriterion, ParallelCriterion, MultiCriterion,
+    TimeDistributedCriterion, CriterionTable,
+)
+from bigdl_tpu.nn.initialization import (
+    InitializationMethod, Default, Xavier, BilinearFiller,
+)

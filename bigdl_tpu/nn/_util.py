@@ -1,0 +1,39 @@
+"""Shared helpers for the nn layer zoo."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def to_axis(dim: int, ndim: int, n_input_dims: Optional[int] = None) -> int:
+    """Convert a 1-based Torch/BigDL dimension to a 0-based axis.
+
+    ``n_input_dims`` reproduces the reference's nInputDims convention: when
+    the actual rank exceeds it, leading dims are batch dims and the 1-based
+    ``dim`` counts from after them (e.g. JoinTable, SplitTable).
+    Negative dims count from the end (Torch allows -1 = last).
+    """
+    if dim < 0:
+        return ndim + dim
+    axis = dim - 1
+    if n_input_dims is not None and ndim > n_input_dims:
+        axis += ndim - n_input_dims
+    return axis
+
+
+def fold_rng(rng, i: int):
+    return None if rng is None else jax.random.fold_in(rng, i)
+
+
+def same_pad(size: int, kernel: int, stride: int) -> tuple[int, int]:
+    """SAME-style padding pair for one spatial dim."""
+    out = -(-size // stride)
+    total = max(0, (out - 1) * stride + kernel - size)
+    return total // 2, total - total // 2
+
+
+def one_based_index(idx: int, length: int) -> int:
+    """1-based index with negative-from-end semantics (ref SelectTable)."""
+    return idx - 1 if idx > 0 else length + idx

@@ -1,0 +1,231 @@
+"""Activation layers (ref nn/: ReLU, Tanh, Sigmoid, SoftMax, ... one Scala
+file each; here thin pure functions over jnp — XLA fuses them into adjacent
+matmuls/convs, which is the TPU answer to the reference's MKL VML calls
+(tensor/TensorNumeric.scala:180-420)).
+
+All are stateless TensorModules except PReLU (learnable) and RReLU
+(stochastic in training).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class ReLU(Module):
+    def __init__(self, ip: bool = False):
+        super().__init__()
+        self.ip = ip  # in-place flag kept for API parity; meaningless under XLA
+
+    def f(self, params, x, **kw):
+        return jnp.maximum(x, 0)
+
+
+class ReLU6(Module):
+    def f(self, params, x, **kw):
+        return jnp.clip(x, 0, 6)
+
+
+class Tanh(Module):
+    def f(self, params, x, **kw):
+        return jnp.tanh(x)
+
+
+class Sigmoid(Module):
+    def f(self, params, x, **kw):
+        return jax.nn.sigmoid(x)
+
+
+class SoftMax(Module):
+    """Softmax over the last dim for 1D/2D input (ref nn/SoftMax.scala)."""
+
+    def f(self, params, x, **kw):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class SoftMin(Module):
+    def f(self, params, x, **kw):
+        return jax.nn.softmax(-x, axis=-1)
+
+
+class LogSoftMax(Module):
+    def f(self, params, x, **kw):
+        return jax.nn.log_softmax(x, axis=-1)
+
+
+class LogSigmoid(Module):
+    def f(self, params, x, **kw):
+        return jax.nn.log_sigmoid(x)
+
+
+class SoftPlus(Module):
+    def __init__(self, beta: float = 1.0):
+        super().__init__()
+        self.beta = beta
+
+    def f(self, params, x, **kw):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(Module):
+    def f(self, params, x, **kw):
+        return x / (1 + jnp.abs(x))
+
+
+class LeakyReLU(Module):
+    def __init__(self, negval: float = 0.01, inplace: bool = False):
+        super().__init__()
+        self.negval = negval
+
+    def f(self, params, x, **kw):
+        return jnp.where(x > 0, x, self.negval * x)
+
+
+class ELU(Module):
+    def __init__(self, alpha: float = 1.0, inplace: bool = False):
+        super().__init__()
+        self.alpha = alpha
+
+    def f(self, params, x, **kw):
+        return jnp.where(x > 0, x, self.alpha * (jnp.exp(x) - 1))
+
+
+class PReLU(Module):
+    """Learnable leaky slope; n_output_plane=0 means one shared slope
+    (ref nn/PReLU.scala)."""
+
+    def __init__(self, n_output_plane: int = 0):
+        super().__init__()
+        self.n_output_plane = n_output_plane
+
+    def init(self, rng):
+        n = max(self.n_output_plane, 1)
+        return {"weight": jnp.full((n,), 0.25, dtype=jnp.float32)}
+
+    def f(self, params, x, **kw):
+        w = params["weight"]
+        if self.n_output_plane > 0 and x.ndim > 1:
+            # per-channel slope: channel dim is 1 for batched input (N,C,...)
+            # or 0 for unbatched (C,...); prefer the axis whose size matches.
+            n = self.n_output_plane
+            if x.shape[1] == n:
+                ch_axis = 1
+            elif x.shape[0] == n:
+                ch_axis = 0
+            else:
+                raise ValueError(
+                    f"PReLU({n}): no input dim of size {n} in shape {x.shape}")
+            shape = [1] * x.ndim
+            shape[ch_axis] = n
+            w = w.reshape(shape)
+        return jnp.where(x > 0, x, w * x)
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU: slope ~ U(lower, upper) in training, fixed
+    mean slope in eval (ref nn/RReLU.scala)."""
+
+    def __init__(self, lower: float = 1.0 / 8, upper: float = 1.0 / 3,
+                 inplace: bool = False):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def f(self, params, x, *, training=False, rng=None, **kw):
+        if training and rng is not None:
+            a = jax.random.uniform(rng, x.shape, minval=self.lower, maxval=self.upper)
+        else:
+            a = (self.lower + self.upper) / 2
+        return jnp.where(x >= 0, x, a * x)
+
+
+class HardTanh(Module):
+    def __init__(self, min_value: float = -1.0, max_value: float = 1.0,
+                 inplace: bool = False):
+        super().__init__()
+        self.min_value = min_value
+        self.max_value = max_value
+
+    def f(self, params, x, **kw):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class HardShrink(Module):
+    def __init__(self, lam: float = 0.5):
+        super().__init__()
+        self.lam = lam
+
+    def f(self, params, x, **kw):
+        return jnp.where(jnp.abs(x) > self.lam, x, 0.0)
+
+
+class SoftShrink(Module):
+    def __init__(self, lam: float = 0.5):
+        super().__init__()
+        self.lam = lam
+
+    def f(self, params, x, **kw):
+        return jnp.where(x > self.lam, x - self.lam,
+                         jnp.where(x < -self.lam, x + self.lam, 0.0))
+
+
+class TanhShrink(Module):
+    def f(self, params, x, **kw):
+        return x - jnp.tanh(x)
+
+
+class Threshold(Module):
+    """x if x > th else v (ref nn/Threshold.scala)."""
+
+    def __init__(self, th: float = 1e-6, v: float = 0.0, ip: bool = False):
+        super().__init__()
+        self.th = th
+        self.v = v
+
+    def f(self, params, x, **kw):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class Clamp(HardTanh):
+    def __init__(self, min_value: float, max_value: float):
+        super().__init__(min_value, max_value)
+
+
+class Power(Module):
+    """(shift + scale*x)^power (ref nn/Power.scala)."""
+
+    def __init__(self, power: float, scale: float = 1.0, shift: float = 0.0):
+        super().__init__()
+        self.power = power
+        self.scale = scale
+        self.shift = shift
+
+    def f(self, params, x, **kw):
+        return jnp.power(self.shift + self.scale * x, self.power)
+
+
+class Square(Module):
+    def f(self, params, x, **kw):
+        return jnp.square(x)
+
+
+class Sqrt(Module):
+    def f(self, params, x, **kw):
+        return jnp.sqrt(x)
+
+
+class Log(Module):
+    def f(self, params, x, **kw):
+        return jnp.log(x)
+
+
+class Exp(Module):
+    def f(self, params, x, **kw):
+        return jnp.exp(x)
+
+
+class Abs(Module):
+    def f(self, params, x, **kw):
+        return jnp.abs(x)

@@ -1,0 +1,257 @@
+"""Convolution layers (ref nn/SpatialConvolution.scala:104-199 and family).
+
+The reference lowers conv to im2col + MKL gemm with hand-threaded per-sample
+parallelism (NNPrimitive.scala:24-335).  On TPU the whole of that machinery
+is one ``lax.conv_general_dilated`` call: XLA tiles it onto the MXU and
+fuses the bias/activation — there is no im2col buffer, no per-sample
+threading, no col2im backward (autodiff derives it).
+
+Layouts preserve Torch conventions for import parity: activations NCHW,
+weights OIHW, grouped conv via ``feature_group_count``.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.initialization import Default, InitializationMethod
+from bigdl_tpu.nn.module import Module
+
+_DN = ("NCHW", "OIHW", "NCHW")
+
+
+class SpatialConvolution(Module):
+    """2-D convolution (ref nn/SpatialConvolution.scala, 579 LoC).
+
+    Args mirror the reference: (n_input_plane, n_output_plane, kernel_w,
+    kernel_h, stride_w, stride_h, pad_w, pad_h, n_group).  Note the
+    reference's W-before-H argument order is kept.
+    """
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
+                 propagate_back: bool = True, with_bias: bool = True,
+                 init_method: type[InitializationMethod] = Default):
+        super().__init__()
+        assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h
+        self.stride_w = stride_w
+        self.stride_h = stride_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h
+        self.n_group = n_group
+        self.with_bias = with_bias
+        self.init_method = init_method
+
+    def _fans(self):
+        fan_in = self.n_input_plane // self.n_group * self.kernel_h * self.kernel_w
+        fan_out = self.n_output_plane // self.n_group * self.kernel_h * self.kernel_w
+        return fan_in, fan_out
+
+    def init(self, rng):
+        wk, bk = jax.random.split(rng)
+        fan_in, fan_out = self._fans()
+        shape = (self.n_output_plane, self.n_input_plane // self.n_group,
+                 self.kernel_h, self.kernel_w)
+        p = {"weight": self.init_method.weight(wk, shape, fan_in=fan_in, fan_out=fan_out)
+             if self.init_method is not Default
+             else Default.weight(wk, shape, fan_in=fan_in)}
+        if self.with_bias:
+            p["bias"] = Default.bias(bk, (self.n_output_plane,), fan_in=fan_in)
+        return p
+
+    def f(self, params, x, **kw):
+        squeeze = x.ndim == 3
+        if squeeze:  # CHW -> NCHW (the reference accepts 3-D input)
+            x = x[None]
+        y = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.stride_h, self.stride_w),
+            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+            dimension_numbers=_DN,
+            feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None]
+        return y[0] if squeeze else y
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """Memory-sharing variant of SpatialConvolution
+    (ref nn/SpatialShareConvolution.scala).  The reference shares im2col
+    buffers across instances; under XLA buffer reuse is the compiler's job,
+    so this is computationally identical to SpatialConvolution."""
+
+
+class SpatialDilatedConvolution(SpatialConvolution):
+    """Atrous convolution (ref nn/SpatialDilatedConvolution.scala)."""
+
+    def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
+                 stride_w=1, stride_h=1, pad_w=0, pad_h=0,
+                 dilation_w: int = 1, dilation_h: int = 1,
+                 init_method: type[InitializationMethod] = Default):
+        super().__init__(n_input_plane, n_output_plane, kernel_w, kernel_h,
+                         stride_w, stride_h, pad_w, pad_h, init_method=init_method)
+        self.dilation_w = dilation_w
+        self.dilation_h = dilation_h
+
+    def f(self, params, x, **kw):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        y = lax.conv_general_dilated(
+            x, params["weight"],
+            window_strides=(self.stride_h, self.stride_w),
+            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+            rhs_dilation=(self.dilation_h, self.dilation_w),
+            dimension_numbers=_DN,
+        )
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None]
+        return y[0] if squeeze else y
+
+
+class SpatialFullConvolution(Module):
+    """Transposed convolution / "deconvolution"
+    (ref nn/SpatialFullConvolution.scala).  Output size =
+    (in-1)*stride - 2*pad + kernel + adj.  Implemented as an input-dilated
+    conv with the spatially-flipped kernel — exactly the op XLA emits for
+    conv gradients, so it lands on the MXU the same way."""
+
+    def __init__(self, n_input_plane: int, n_output_plane: int,
+                 kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
+                 pad_w: int = 0, pad_h: int = 0, adj_w: int = 0, adj_h: int = 0,
+                 n_group: int = 1, no_bias: bool = False,
+                 init_method: type[InitializationMethod] = Default):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h
+        self.stride_w = stride_w
+        self.stride_h = stride_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h
+        self.adj_w = adj_w
+        self.adj_h = adj_h
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.init_method = init_method
+
+    def init(self, rng):
+        wk, bk = jax.random.split(rng)
+        fan_in = self.n_output_plane // self.n_group * self.kernel_h * self.kernel_w
+        # Torch layout for full conv: (nInput, nOutput/group, kH, kW)
+        shape = (self.n_input_plane, self.n_output_plane // self.n_group,
+                 self.kernel_h, self.kernel_w)
+        p = {"weight": self.init_method.weight(wk, shape, fan_in=fan_in)}
+        if self.with_bias:
+            p["bias"] = Default.bias(bk, (self.n_output_plane,), fan_in=fan_in)
+        return p
+
+    def f(self, params, x, **kw):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        w = params["weight"]
+        # (I, O/g, kh, kw) -> flip spatial, swap to (O, I/g, kh, kw)
+        w = jnp.flip(w, axis=(-2, -1))
+        if self.n_group > 1:
+            ig = self.n_input_plane // self.n_group
+            w = w.reshape(self.n_group, ig, self.n_output_plane // self.n_group,
+                          self.kernel_h, self.kernel_w)
+            w = jnp.swapaxes(w, 1, 2).reshape(
+                self.n_output_plane, ig, self.kernel_h, self.kernel_w)
+        else:
+            w = jnp.swapaxes(w, 0, 1)
+        pad_h = (self.kernel_h - 1 - self.pad_h, self.kernel_h - 1 - self.pad_h + self.adj_h)
+        pad_w = (self.kernel_w - 1 - self.pad_w, self.kernel_w - 1 - self.pad_w + self.adj_w)
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1),
+            padding=(pad_h, pad_w),
+            lhs_dilation=(self.stride_h, self.stride_w),
+            dimension_numbers=_DN,
+            feature_group_count=self.n_group,
+        )
+        if self.with_bias:
+            y = y + params["bias"][None, :, None, None]
+        return y[0] if squeeze else y
+
+
+class SpatialConvolutionMap(Module):
+    """Convolution with an explicit input->output connection table
+    (ref nn/SpatialConvolutionMap.scala).  ``conn_table`` is a (K, 2) array
+    of 1-based (input_plane, output_plane) pairs, as in Torch.  Implemented
+    as a dense conv with a frozen sparsity mask — XLA still gets one MXU
+    matmul, and masked weights stay exactly zero through training because
+    the mask also zeroes their gradients (mask is applied inside f)."""
+
+    def __init__(self, conn_table, kernel_w: int, kernel_h: int,
+                 stride_w: int = 1, stride_h: int = 1, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        import numpy as np
+        ct = np.asarray(conn_table, dtype=np.int32)
+        self.conn_table = ct
+        self.n_input_plane = int(ct[:, 0].max())
+        self.n_output_plane = int(ct[:, 1].max())
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h
+        self.stride_w = stride_w
+        self.stride_h = stride_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h
+        mask = np.zeros((self.n_output_plane, self.n_input_plane, 1, 1), dtype=np.float32)
+        for i, o in ct:
+            mask[o - 1, i - 1, 0, 0] = 1.0
+        self._mask = mask
+
+    @staticmethod
+    def full(nin: int, nout: int):
+        import numpy as np
+        return np.array([(i + 1, o + 1) for o in range(nout) for i in range(nin)],
+                        dtype=np.int32)
+
+    @staticmethod
+    def one_to_one(n: int):
+        import numpy as np
+        return np.array([(i + 1, i + 1) for i in range(n)], dtype=np.int32)
+
+    @staticmethod
+    def random(nin: int, nout: int, nto: int, seed: int = 0):
+        import numpy as np
+        rng = np.random.RandomState(seed)
+        pairs = []
+        for o in range(nout):
+            for i in rng.choice(nin, size=nto, replace=False):
+                pairs.append((i + 1, o + 1))
+        return np.array(pairs, dtype=np.int32)
+
+    def init(self, rng):
+        wk, bk = jax.random.split(rng)
+        nto = max(1, len(self.conn_table) // self.n_output_plane)
+        stdv = 1.0 / math.sqrt(self.kernel_w * self.kernel_h * nto)
+        w = jax.random.uniform(
+            wk, (self.n_output_plane, self.n_input_plane, self.kernel_h, self.kernel_w),
+            minval=-stdv, maxval=stdv)
+        b = jax.random.uniform(bk, (self.n_output_plane,), minval=-stdv, maxval=stdv)
+        return {"weight": w * self._mask, "bias": b}
+
+    def f(self, params, x, **kw):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        y = lax.conv_general_dilated(
+            x, params["weight"] * self._mask,
+            window_strides=(self.stride_h, self.stride_w),
+            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+            dimension_numbers=_DN,
+        )
+        y = y + params["bias"][None, :, None, None]
+        return y[0] if squeeze else y

@@ -1,0 +1,435 @@
+"""Criterions (ref nn/: ClassNLLCriterion, MSECriterion, BCECriterion, ...,
+~25 losses; each was a Scala file with hand-written updateOutput and
+updateGradInput — here each is one pure ``loss`` function and the gradient
+is derived by XLA).
+
+Conventions preserved from Torch/BigDL: class targets are **1-based**;
+``size_average=True`` (the default) means mean-reduction over the batch.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Criterion
+from bigdl_tpu.utils.table import Table
+
+
+def _seq(x):
+    return x.to_seq() if isinstance(x, Table) else list(x)
+
+
+def _reduce(x, size_average: bool):
+    return jnp.mean(x) if size_average else jnp.sum(x)
+
+
+class ClassNLLCriterion(Criterion):
+    """Negative log-likelihood over log-probabilities, 1-based integer
+    targets, optional per-class weights (ref nn/ClassNLLCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        if output.ndim == 1:
+            output = output[None]
+            target = jnp.atleast_1d(target)
+        idx = target.astype(jnp.int32) - 1
+        picked = jnp.take_along_axis(output, idx[:, None], axis=1)[:, 0]
+        if self.weights is not None:
+            w = jnp.take(self.weights, idx)
+            total = -jnp.sum(w * picked)
+            return total / jnp.sum(w) if self.size_average else total
+        return _reduce(-picked, self.size_average)
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (ref nn/CrossEntropyCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self._nll = ClassNLLCriterion(weights, size_average)
+
+    def loss(self, output, target):
+        return self._nll.loss(jax.nn.log_softmax(output, axis=-1), target)
+
+
+class MSECriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        return _reduce(jnp.square(output - target), self.size_average)
+
+
+class AbsCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        return _reduce(jnp.abs(output - target), self.size_average)
+
+
+class BCECriterion(Criterion):
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        eps = 1e-12
+        per = -(target * jnp.log(output + eps) + (1 - target) * jnp.log(1 - output + eps))
+        if self.weights is not None:
+            per = per * self.weights
+        return _reduce(per, self.size_average)
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || output) with output already log-probabilities
+    (ref nn/DistKLDivCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        per = jnp.where(target > 0, target * (jnp.log(jnp.maximum(target, 1e-12)) - output), 0.0)
+        return _reduce(per, self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        d = jnp.abs(output - target)
+        per = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(per, self.size_average)
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """Detection-style smooth-L1 with sigma scaling and inside/outside
+    weights (ref nn/SmoothL1CriterionWithWeights.scala).  Target is a table
+    {bbox_target, inside_w, outside_w}; ``num`` normalizes the sum."""
+
+    def __init__(self, sigma: float = 1.0, num: int = 0):
+        super().__init__()
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def loss(self, output, target):
+        tgt, in_w, out_w = _seq(target)
+        d = in_w * (output - tgt)
+        ad = jnp.abs(d)
+        per = jnp.where(ad < 1.0 / self.sigma2,
+                        0.5 * self.sigma2 * d * d,
+                        ad - 0.5 / self.sigma2)
+        total = jnp.sum(out_w * per)
+        return total / self.num if self.num > 0 else total
+
+
+class MarginCriterion(Criterion):
+    """Hinge: max(0, margin - y*x) (ref nn/MarginCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        return _reduce(jnp.maximum(0.0, self.margin - output * target), self.size_average)
+
+
+class MarginRankingCriterion(Criterion):
+    """max(0, -y*(x1-x2) + margin) over table input {x1, x2}
+    (ref nn/MarginRankingCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        x1, x2 = _seq(output)
+        y = target[1] if isinstance(target, Table) else target
+        return _reduce(jnp.maximum(0.0, -y * (x1 - x2) + self.margin), self.size_average)
+
+
+class MultiMarginCriterion(Criterion):
+    """Multiclass hinge loss, p in {1,2} (ref nn/MultiMarginCriterion.scala)."""
+
+    def __init__(self, p: int = 1, weights=None, margin: float = 1.0,
+                 size_average: bool = True):
+        super().__init__()
+        self.p = p
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.margin = margin
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        if output.ndim == 1:
+            output = output[None]
+            target = jnp.atleast_1d(target)
+        n, c = output.shape
+        idx = target.astype(jnp.int32) - 1
+        x_y = jnp.take_along_axis(output, idx[:, None], axis=1)
+        m = jnp.maximum(0.0, self.margin - x_y + output)
+        if self.p == 2:
+            m = m * m
+        if self.weights is not None:
+            m = m * jnp.take(self.weights, idx)[:, None]
+        not_target = jnp.arange(c)[None, :] != idx[:, None]
+        per = jnp.sum(jnp.where(not_target, m, 0.0), axis=1) / c
+        return _reduce(per, self.size_average)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Torch multilabel hinge: per sample, targets are 1-based class indices
+    padded with 0 (ref nn/MultiLabelMarginCriterion.scala)."""
+
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        if output.ndim == 1:
+            output = output[None]
+            target = target[None]
+        n, c = output.shape
+        tgt = target.astype(jnp.int32)
+        # valid targets: nonzero entries before the first zero
+        first_zero = jnp.cumsum(tgt == 0, axis=1) > 0
+        valid = (tgt > 0) & ~first_zero
+        is_target = jnp.zeros((n, c), dtype=bool)
+        idx0 = jnp.clip(tgt - 1, 0, c - 1)
+        is_target = jax.vmap(
+            lambda row, iv, vm: row.at[jnp.where(vm, iv, c - 1)].set(vm | row[jnp.where(vm, iv, c - 1)])
+        )(is_target, idx0, valid)
+        x_t = jnp.where(valid, jnp.take_along_axis(output, idx0, axis=1), 0.0)  # (n, K)
+        # for each valid target t and each non-target j: max(0, 1 - (x_t - x_j))
+        diff = 1.0 - x_t[:, :, None] + output[:, None, :]  # (n, K, C)
+        hinge = jnp.maximum(0.0, diff)
+        mask = valid[:, :, None] & ~is_target[:, None, :]
+        per = jnp.sum(jnp.where(mask, hinge, 0.0), axis=(1, 2)) / c
+        return _reduce(per, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    """Sigmoid + BCE multilabel loss (ref nn/MultiLabelSoftMarginCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average: bool = True):
+        super().__init__()
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        per = jax.nn.softplus(-output) * target + jax.nn.softplus(output) * (1 - target)
+        if self.weights is not None:
+            per = per * self.weights
+        if output.ndim > 1:
+            per = jnp.sum(per, axis=-1) / output.shape[-1]
+        return _reduce(per, self.size_average)
+
+
+class SoftMarginCriterion(Criterion):
+    def __init__(self, size_average: bool = True):
+        super().__init__()
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        return _reduce(jax.nn.softplus(-output * target), self.size_average)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 1.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        per = jnp.where(target == 1, output, jnp.maximum(0.0, self.margin - output))
+        return _reduce(per, self.size_average)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """Hinge on the L1 distance of a pair {x1, x2}
+    (ref nn/L1HingeEmbeddingCriterion.scala)."""
+
+    def __init__(self, margin: float = 1.0):
+        super().__init__()
+        self.margin = margin
+
+    def loss(self, output, target):
+        x1, x2 = _seq(output)
+        d = jnp.sum(jnp.abs(x1 - x2))
+        y = target if jnp.ndim(target) == 0 else target.reshape(())
+        return jnp.where(y == 1, d, jnp.maximum(0.0, self.margin - d))
+
+
+class CosineEmbeddingCriterion(Criterion):
+    def __init__(self, margin: float = 0.0, size_average: bool = True):
+        super().__init__()
+        self.margin = margin
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        x1, x2 = _seq(output)
+        if x1.ndim == 1:
+            x1, x2 = x1[None], x2[None]
+        y = target[1] if isinstance(target, Table) else target
+        y = jnp.reshape(y, (-1,))
+        cos = jnp.sum(x1 * x2, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+        per = jnp.where(y == 1, 1.0 - cos, jnp.maximum(0.0, cos - self.margin))
+        return _reduce(per, self.size_average)
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against a regular-simplex embedding of the target class
+    (ref nn/ClassSimplexCriterion.scala)."""
+
+    def __init__(self, n_classes: int):
+        super().__init__()
+        self.n_classes = n_classes
+        self.simplex = jnp.asarray(self._build_simplex(n_classes))
+
+    @staticmethod
+    def _build_simplex(n):
+        """n unit vectors in R^n with pairwise dot -1/n (Cholesky-style
+        recursive construction of the regular simplex)."""
+        import numpy as np
+        mat = np.zeros((n, n), dtype=np.float64)
+        for k in range(n):
+            mat[k, k] = np.sqrt(max(1.0 - float(np.dot(mat[k, :k], mat[k, :k])), 0.0))
+            if mat[k, k] > 0:
+                for c in range(k + 1, n):
+                    mat[c, k] = (-1.0 / n - float(np.dot(mat[k, :k], mat[c, :k]))) / mat[k, k]
+        return mat.astype(np.float32)
+
+    def loss(self, output, target):
+        idx = target.astype(jnp.int32) - 1
+        tgt = jnp.take(self.simplex, idx, axis=0)
+        return jnp.mean(jnp.square(output - tgt))
+
+
+class L1Cost(Criterion):
+    """Sum of absolute values; target ignored (ref nn/L1Cost.scala)."""
+
+    def loss(self, output, target=None):
+        return jnp.sum(jnp.abs(output))
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Caffe SoftmaxWithLoss: softmax + NLL with ignore_label and
+    normalization modes (ref nn/SoftmaxWithCriterion.scala).  Input is
+    (N, C, ...) raw scores."""
+
+    def __init__(self, ignore_label: Optional[int] = None,
+                 normalize_mode: str = "VALID"):
+        super().__init__()
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def loss(self, output, target):
+        logp = jax.nn.log_softmax(output, axis=1)
+        idx = target.astype(jnp.int32) - 1
+        picked = jnp.take_along_axis(logp, idx[:, None], axis=1)[:, 0] \
+            if logp.ndim == 2 else jnp.take_along_axis(
+                logp, idx[:, None], axis=1).squeeze(1)
+        if self.ignore_label is not None:
+            validm = target.astype(jnp.int32) != self.ignore_label
+            picked = jnp.where(validm, picked, 0.0)
+            count = jnp.sum(validm)
+        else:
+            validm = None
+            count = picked.size
+        total = -jnp.sum(picked)
+        if self.normalize_mode == "VALID":
+            return total / jnp.maximum(count, 1)
+        if self.normalize_mode == "BATCH_SIZE":
+            return total / output.shape[0]
+        if self.normalize_mode == "FULL":
+            return total / picked.size
+        return total  # NONE
+
+
+class ParallelCriterion(Criterion):
+    """Weighted sum of member criterions applied to corresponding
+    input/target table slots (ref nn/ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target: bool = False):
+        super().__init__()
+        self.repeat_target = repeat_target
+        self.criterions: list[Criterion] = []
+        self.weights: list[float] = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "ParallelCriterion":
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def loss(self, output, target):
+        outs = _seq(output)
+        tgts = [target] * len(outs) if self.repeat_target else _seq(target)
+        total = 0.0
+        for crit, w, o, t in zip(self.criterions, self.weights, outs, tgts):
+            total = total + w * crit.loss(o, t)
+        return total
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the SAME input/target
+    (ref nn/MultiCriterion.scala)."""
+
+    def __init__(self):
+        super().__init__()
+        self.criterions: list[Criterion] = []
+        self.weights: list[float] = []
+
+    def add(self, criterion: Criterion, weight: float = 1.0) -> "MultiCriterion":
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def loss(self, output, target):
+        total = 0.0
+        for crit, w in zip(self.criterions, self.weights):
+            total = total + w * crit.loss(output, target)
+        return total
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep of (batch, time, ...) output
+    (ref nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, criterion: Criterion, size_average: bool = False):
+        super().__init__()
+        self.criterion = criterion
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        t_steps = output.shape[1]
+        total = 0.0
+        for t in range(t_steps):
+            total = total + self.criterion.loss(output[:, t], target[:, t])
+        return total / t_steps if self.size_average else total
+
+
+class CriterionTable(Criterion):
+    """Wrap a criterion so (input, target) both come from one table
+    (ref nn/CriterionTable.scala)."""
+
+    def __init__(self, criterion: Criterion):
+        super().__init__()
+        self.criterion = criterion
+
+    def loss(self, output, target=None):
+        xs = _seq(output)
+        return self.criterion.loss(xs[0], xs[1] if len(xs) > 1 else target)

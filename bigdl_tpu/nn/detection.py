@@ -1,0 +1,63 @@
+"""Detection utilities (ref nn/Nms.scala).
+
+Non-maximum suppression with static output shape: returns a fixed-length
+1-based index vector padded with 0 plus a valid count, so it composes with
+jit (XLA has no dynamic shapes; the reference returns a variable-length
+index array on CPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def nms(boxes, scores, iou_threshold: float = 0.5, max_output: int = 100):
+    """Greedy NMS. boxes (N,4) as (x1,y1,x2,y2); returns (indices_1based
+    padded to max_output with 0, count)."""
+    boxes = jnp.asarray(boxes, dtype=jnp.float32)
+    scores = jnp.asarray(scores, dtype=jnp.float32)
+    n = boxes.shape[0]
+    areas = (boxes[:, 2] - boxes[:, 0] + 1) * (boxes[:, 3] - boxes[:, 1] + 1)
+    order = jnp.argsort(-scores)
+
+    def iou(i, j):
+        xx1 = jnp.maximum(boxes[i, 0], boxes[j, 0])
+        yy1 = jnp.maximum(boxes[i, 1], boxes[j, 1])
+        xx2 = jnp.minimum(boxes[i, 2], boxes[j, 2])
+        yy2 = jnp.minimum(boxes[i, 3], boxes[j, 3])
+        w = jnp.maximum(0.0, xx2 - xx1 + 1)
+        h = jnp.maximum(0.0, yy2 - yy1 + 1)
+        inter = w * h
+        return inter / (areas[i] + areas[j] - inter)
+
+    def body(state):
+        keep, count, alive = state
+        scores_alive = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(scores_alive)
+        keep = keep.at[count].set(best + 1)
+        ious = jax.vmap(lambda j: iou(best, j))(jnp.arange(n))
+        alive = alive & (ious <= iou_threshold)
+        alive = alive.at[best].set(False)
+        return keep, count + 1, alive
+
+    def cond(state):
+        keep, count, alive = state
+        return jnp.any(alive) & (count < max_output)
+
+    keep0 = jnp.zeros((max_output,), dtype=jnp.int32)
+    alive0 = jnp.ones((n,), dtype=bool)
+    keep, count, _ = jax.lax.while_loop(cond, body, (keep0, 0, alive0))
+    return keep, count
+
+
+class Nms:
+    """Object-style wrapper mirroring the reference's Nms class."""
+
+    def __init__(self, iou_threshold: float = 0.5, max_output: int = 100):
+        self.iou_threshold = iou_threshold
+        self.max_output = max_output
+
+    def __call__(self, boxes, scores):
+        keep, count = nms(boxes, scores, self.iou_threshold, self.max_output)
+        return np.asarray(keep[:int(count)])

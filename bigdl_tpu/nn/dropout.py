@@ -1,0 +1,98 @@
+"""Stochastic / gradient-shaping layers (ref nn/Dropout.scala:49-93,
+L1Penalty, GradientReversal).
+
+Dropout noise comes from ``jax.random`` keys threaded through ``apply``
+(the reference generates Bernoulli noise on the Engine.model pool; on TPU
+the PRNG runs on device inside the fused program).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn.module import Module
+
+
+class Dropout(Module):
+    def __init__(self, init_p: float = 0.5, inplace: bool = False,
+                 scale: bool = True):
+        super().__init__()
+        self.p = init_p
+        self.scale = scale
+
+    def f(self, params, x, *, training=False, rng=None, **kw):
+        if not training or self.p == 0.0:
+            if not self.scale:
+                return x * (1 - self.p)
+            return x
+        if rng is None:
+            raise ValueError("Dropout in training mode needs an rng key")
+        keep = jax.random.bernoulli(rng, 1.0 - self.p, x.shape)
+        y = jnp.where(keep, x, 0.0)
+        if self.scale:
+            y = y / (1.0 - self.p)
+        return y
+
+    def set_p(self, p: float) -> "Dropout":
+        self.p = p
+        return self
+
+
+class L1Penalty(Module):
+    """Identity forward that injects an L1 subgradient into the backward
+    pass (ref nn/L1Penalty.scala).  Expressed as a custom VJP — the
+    functional rendering of the reference's gradInput += l1weight*sign(x)."""
+
+    def __init__(self, l1weight: float, size_average: bool = False,
+                 provide_output: bool = True):
+        super().__init__()
+        self.l1weight = l1weight
+        self.size_average = size_average
+
+        @jax.custom_vjp
+        def _penalty(x):
+            return x
+
+        def _fwd(x):
+            return x, (x,)
+
+        def _bwd(res, g):
+            (x,) = res
+            w = self.l1weight / x.size if self.size_average else self.l1weight
+            return (g + w * jnp.sign(x),)
+
+        _penalty.defvjp(_fwd, _bwd)
+        self._penalty = _penalty
+
+    def f(self, params, x, **kw):
+        return self._penalty(x)
+
+
+class GradientReversal(Module):
+    """Identity forward, -lambda-scaled gradient backward
+    (ref nn/GradientReversal.scala — the DANN trick)."""
+
+    def __init__(self, the_lambda: float = 1.0):
+        super().__init__()
+        self.the_lambda = the_lambda
+
+        @jax.custom_vjp
+        def _rev(x, lam):
+            return x
+
+        def _fwd(x, lam):
+            return x, (lam,)
+
+        def _bwd(res, g):
+            (lam,) = res
+            return (-lam * g, None)
+
+        _rev.defvjp(_fwd, _bwd)
+        self._rev = _rev
+
+    def set_lambda(self, lam: float) -> "GradientReversal":
+        self.the_lambda = lam
+        return self
+
+    def f(self, params, x, **kw):
+        return self._rev(x, self.the_lambda)

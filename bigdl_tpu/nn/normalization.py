@@ -1,0 +1,208 @@
+"""Normalization layers (ref nn/BatchNormalization.scala:151-451,
+SpatialBatchNormalization, SpatialCrossMapLRN, Spatial*Normalization,
+Normalize).
+
+BatchNormalization is the one stateful module in the zoo: running mean/var
+live in ``buffers`` and flow functionally through ``apply`` (the reference
+mutates them in place and threads per-channel work over Engine.model; XLA
+fuses the whole normalization into neighboring ops instead).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+
+
+class BatchNormalization(Module):
+    """Batch norm over (N, D) input (ref nn/BatchNormalization.scala).
+
+    Torch momentum convention: running = (1-momentum)*running + momentum*batch.
+    """
+
+    _reduce_axes = (0,)
+    _param_shape_from = "n_output"
+
+    def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
+                 affine: bool = True):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+
+    def init(self, rng):
+        if not self.affine:
+            return {}
+        return {"weight": jax.random.uniform(rng, (self.n_output,)),
+                "bias": jnp.zeros((self.n_output,))}
+
+    def init_buffers(self):
+        return {"running_mean": jnp.zeros((self.n_output,)),
+                "running_var": jnp.ones((self.n_output,))}
+
+    def _reshape_stat(self, s, ndim):
+        if ndim <= 2:
+            return s
+        shape = [1] * ndim
+        shape[1] = self.n_output
+        return s.reshape(shape)
+
+    def apply(self, params, x, *, buffers=None, training=False, rng=None):
+        buffers = buffers or self.init_buffers()
+        axes = tuple(i for i in range(x.ndim) if i != (1 if x.ndim > 2 else x.ndim - 1))
+        if training:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            n = x.size // self.n_output
+            unbiased = var * n / max(n - 1, 1)
+            new_buffers = {
+                "running_mean": (1 - self.momentum) * buffers["running_mean"] + self.momentum * mean,
+                "running_var": (1 - self.momentum) * buffers["running_var"] + self.momentum * unbiased,
+            }
+        else:
+            mean, var = buffers["running_mean"], buffers["running_var"]
+            new_buffers = buffers
+        mean = self._reshape_stat(mean, x.ndim)
+        var = self._reshape_stat(var, x.ndim)
+        y = (x - mean) * lax.rsqrt(var + self.eps)
+        if self.affine:
+            w = self._reshape_stat(params["weight"], x.ndim)
+            b = self._reshape_stat(params["bias"], x.ndim)
+            y = y * w + b
+        return y, new_buffers
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """Batch norm over (N, C, H, W) reducing N,H,W
+    (ref nn/SpatialBatchNormalization.scala)."""
+
+
+class Normalize(Module):
+    """Lp-normalize each row (ref nn/Normalize.scala)."""
+
+    def __init__(self, p: float = 2.0, eps: float = 1e-10):
+        super().__init__()
+        self.p = p
+        self.eps = eps
+
+    def f(self, params, x, **kw):
+        if self.p == float("inf"):
+            norm = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        else:
+            norm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), self.p), axis=-1,
+                                     keepdims=True), 1.0 / self.p)
+        return x / jnp.maximum(norm, self.eps)
+
+
+class SpatialCrossMapLRN(Module):
+    """AlexNet-style local response normalization across channels
+    (ref nn/SpatialCrossMapLRN.scala):
+    y = x / (k + alpha/size * sum_{window} x^2)^beta."""
+
+    def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
+                 k: float = 1.0):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def f(self, params, x, **kw):
+        half = (self.size - 1) // 2
+        sq = jnp.square(x)
+        window_sum = lax.reduce_window(
+            sq, 0.0, lax.add,
+            window_dimensions=(1, self.size, 1, 1),
+            window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)),
+        )
+        return x * jnp.power(self.k + self.alpha / self.size * window_sum, -self.beta)
+
+
+def _smooth(x, kernel2d):
+    """Depthwise 'same' smoothing with border renormalization: returns
+    (weighted local mean, coverage coefficient) as Torch's Spatial*
+    normalizations compute them."""
+    kh, kw = kernel2d.shape
+    k = (kernel2d / kernel2d.sum()).astype(x.dtype)
+    C = x.shape[1]
+    w = jnp.zeros((C, 1, kh, kw), dtype=x.dtype) + k[None, None]
+    pad = ((kh // 2, (kh - 1) // 2), (kw // 2, (kw - 1) // 2))
+    mean = lax.conv_general_dilated(
+        x, w, (1, 1), pad, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=C) / C
+    ones = jnp.ones_like(x[:, :1])
+    coef = lax.conv_general_dilated(
+        ones, w[:1], (1, 1), pad, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return mean, coef
+
+
+def _gaussian_kernel(size: int) -> jnp.ndarray:
+    import numpy as np
+    g = np.exp(-0.5 * ((np.arange(size) - (size - 1) / 2.0) / (size / 4.0)) ** 2)
+    k = np.outer(g, g)
+    return jnp.asarray(k / k.sum(), dtype=jnp.float32)
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract the kernel-weighted local mean (summed over channels), with
+    border renormalization (ref nn/SpatialSubtractiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.kernel = kernel if kernel is not None else _gaussian_kernel(9)
+
+    def f(self, params, x, **kw):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        kernel2d = jnp.asarray(self.kernel)
+        mean, coef = _smooth(x, kernel2d)
+        mean_all = jnp.sum(mean, axis=1, keepdims=True)  # cross-channel mean
+        y = x - mean_all / jnp.maximum(coef, 1e-12)
+        return y[0] if squeeze else y
+
+
+class SpatialDivisiveNormalization(Module):
+    """Divide by the local standard deviation, thresholded at its per-sample
+    mean (ref nn/SpatialDivisiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4):
+        super().__init__()
+        self.n_input_plane = n_input_plane
+        self.kernel = kernel if kernel is not None else _gaussian_kernel(9)
+        self.threshold = threshold
+        self.thresval = thresval
+
+    def f(self, params, x, **kw):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        kernel2d = jnp.asarray(self.kernel)
+        mean_sq, coef = _smooth(jnp.square(x), kernel2d)
+        local_std = jnp.sqrt(jnp.maximum(
+            jnp.sum(mean_sq, axis=1, keepdims=True) / jnp.maximum(coef, 1e-12), 0.0))
+        per_sample_mean = jnp.mean(local_std, axis=(1, 2, 3), keepdims=True)
+        divisor = jnp.maximum(local_std, per_sample_mean)
+        divisor = jnp.maximum(divisor, self.threshold)
+        y = x / divisor
+        return y[0] if squeeze else y
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization
+    (ref nn/SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane: int = 1, kernel=None,
+                 threshold: float = 1e-4, thresval: float = 1e-4):
+        super().__init__()
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel)
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel, threshold, thresval)
+
+    def f(self, params, x, **kw):
+        return self.div.f({}, self.sub.f({}, x))

@@ -1,0 +1,150 @@
+"""Pooling layers (ref nn/SpatialMaxPooling.scala, SpatialAveragePooling.scala,
+RoiPooling.scala).  The reference hand-writes pooling loops in NNPrimitive
+(:356-498); here they are ``lax.reduce_window`` — XLA lowers to VPU code and
+autodiff derives the backward (the reference's argmax-index bookkeeping
+disappears).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+def _pool_pads(size, kernel, stride, pad, ceil_mode):
+    """Torch-style output sizing: floor or ceil mode; in ceil mode the last
+    window must start inside the (padded) input (Torch SpatialMaxPooling
+    semantics)."""
+    if ceil_mode:
+        out = -(-(size + 2 * pad - kernel) // stride) + 1
+        if (out - 1) * stride >= size + pad:
+            out -= 1
+    else:
+        out = (size + 2 * pad - kernel) // stride + 1
+    needed = (out - 1) * stride + kernel - size - pad
+    return out, (pad, max(needed, 0))
+
+
+class SpatialMaxPooling(Module):
+    def __init__(self, kernel_w: int, kernel_h: int, stride_w: int = None,
+                 stride_h: int = None, pad_w: int = 0, pad_h: int = 0):
+        super().__init__()
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h
+        self.stride_w = stride_w if stride_w is not None else kernel_w
+        self.stride_h = stride_h if stride_h is not None else kernel_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h
+        self.ceil_mode = False
+
+    def ceil(self) -> "SpatialMaxPooling":
+        self.ceil_mode = True
+        return self
+
+    def floor(self) -> "SpatialMaxPooling":
+        self.ceil_mode = False
+        return self
+
+    def f(self, params, x, **kw):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        _, ph = _pool_pads(x.shape[2], self.kernel_h, self.stride_h, self.pad_h, self.ceil_mode)
+        _, pw = _pool_pads(x.shape[3], self.kernel_w, self.stride_w, self.pad_w, self.ceil_mode)
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            window_dimensions=(1, 1, self.kernel_h, self.kernel_w),
+            window_strides=(1, 1, self.stride_h, self.stride_w),
+            padding=((0, 0), (0, 0), ph, pw),
+        )
+        return y[0] if squeeze else y
+
+
+class SpatialAveragePooling(Module):
+    def __init__(self, kernel_w: int, kernel_h: int, stride_w: int = None,
+                 stride_h: int = None, pad_w: int = 0, pad_h: int = 0,
+                 ceil_mode: bool = False, count_include_pad: bool = True,
+                 divide: bool = True):
+        super().__init__()
+        self.kernel_w = kernel_w
+        self.kernel_h = kernel_h
+        self.stride_w = stride_w if stride_w is not None else kernel_w
+        self.stride_h = stride_h if stride_h is not None else kernel_h
+        self.pad_w = pad_w
+        self.pad_h = pad_h
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+
+    def f(self, params, x, **kw):
+        squeeze = x.ndim == 3
+        if squeeze:
+            x = x[None]
+        _, ph = _pool_pads(x.shape[2], self.kernel_h, self.stride_h, self.pad_h, self.ceil_mode)
+        _, pw = _pool_pads(x.shape[3], self.kernel_w, self.stride_w, self.pad_w, self.ceil_mode)
+        dims = (1, 1, self.kernel_h, self.kernel_w)
+        strides = (1, 1, self.stride_h, self.stride_w)
+        pads = ((0, 0), (0, 0), ph, pw)
+        y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
+        if self.divide:
+            if self.count_include_pad:
+                y = y / (self.kernel_h * self.kernel_w)
+            else:
+                ones = jnp.ones_like(x)
+                counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pads)
+                y = y / counts
+        return y[0] if squeeze else y
+
+
+class RoiPooling(Module):
+    """Region-of-interest max pooling for detection (ref nn/RoiPooling.scala).
+
+    Input: Table {features (N,C,H,W), rois (R,5) rows = (batch_idx, x1, y1,
+    x2, y2)} with 0-based batch_idx and roi coords in input-image scale.
+    Output: (R, C, pooled_h, pooled_w).  Implemented as a masked max per
+    output cell, vmapped over rois — static shapes throughout, so one XLA
+    program regardless of roi geometry.
+    """
+
+    def __init__(self, pooled_w: int, pooled_h: int, spatial_scale: float = 1.0):
+        super().__init__()
+        self.pooled_w = pooled_w
+        self.pooled_h = pooled_h
+        self.spatial_scale = spatial_scale
+
+    def f(self, params, x, **kw):
+        feats, rois = (x.to_seq() if isinstance(x, Table) else list(x))
+        N, C, H, W = feats.shape
+        ph, pw = self.pooled_h, self.pooled_w
+
+        def one_roi(roi):
+            b = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * self.spatial_scale)
+            y1 = jnp.round(roi[2] * self.spatial_scale)
+            x2 = jnp.round(roi[3] * self.spatial_scale)
+            y2 = jnp.round(roi[4] * self.spatial_scale)
+            roi_h = jnp.maximum(y2 - y1 + 1, 1.0)
+            roi_w = jnp.maximum(x2 - x1 + 1, 1.0)
+            bin_h = roi_h / ph
+            bin_w = roi_w / pw
+            fmap = feats[b]  # (C, H, W)
+            iy = jnp.arange(ph, dtype=feats.dtype)
+            ix = jnp.arange(pw, dtype=feats.dtype)
+            hstart = jnp.clip(jnp.floor(iy * bin_h) + y1, 0, H)
+            hend = jnp.clip(jnp.ceil((iy + 1) * bin_h) + y1, 0, H)
+            wstart = jnp.clip(jnp.floor(ix * bin_w) + x1, 0, W)
+            wend = jnp.clip(jnp.ceil((ix + 1) * bin_w) + x1, 0, W)
+            hh = jnp.arange(H, dtype=feats.dtype)
+            ww = jnp.arange(W, dtype=feats.dtype)
+            rmask = (hh[None, :] >= hstart[:, None]) & (hh[None, :] < hend[:, None])  # (ph,H)
+            cmask = (ww[None, :] >= wstart[:, None]) & (ww[None, :] < wend[:, None])  # (pw,W)
+            mask = rmask[:, None, :, None] & cmask[None, :, None, :]  # (ph,pw,H,W)
+            empty = ~jnp.any(mask, axis=(2, 3))  # (ph,pw)
+            vals = jnp.where(mask[None], fmap[:, None, None, :, :], -jnp.inf)
+            pooled = jnp.max(vals, axis=(3, 4))  # (C,ph,pw)
+            return jnp.where(empty[None], 0.0, pooled)
+
+        return jax.vmap(one_roi)(rois)

@@ -1,0 +1,237 @@
+"""Recurrent stack (ref nn/Recurrent.scala:60-110, Cell.scala, RNN.scala,
+LSTM.scala, GRU.scala, BiRecurrent.scala, TimeDistributed.scala).
+
+The reference unrolls over time by cloning the cell per timestep with
+shared weight storages.  The TPU-native rendering is ``lax.scan``: one
+traced cell step, weights closed over once (the sharing is free), O(1)
+compile size in sequence length, and XLA pipelines the steps.  Gates are
+fused into single matmuls so the MXU sees one large GEMM per step instead
+of the reference's per-gate compositional graph (nn/LSTM.scala builds LSTM
+out of Linear/Sigmoid/CMulTable pieces).
+
+Layout follows the reference: input (batch, time, feature) — batchDim=1,
+timeDim=2 in 1-based terms (nn/Recurrent.scala:37-38).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn.table_ops import CAddTable
+
+
+class Cell(Module):
+    """Base recurrent cell: subclasses define ``init``, ``init_state`` and
+    ``step`` (ref nn/Cell.scala:35-80 hidResize ~= init_state)."""
+
+    hidden_size: int
+
+    def init_state(self, batch: int, dtype=jnp.float32):
+        raise NotImplementedError
+
+    def step(self, params, x_t, state, *, training=False, rng=None):
+        """(params, (B,in), state) -> (output (B,hidden), new_state)."""
+        raise NotImplementedError
+
+    def _gate_dropout(self, gates, training, rng):
+        """Dropout on the gate pre-activations (the reference applies
+        Dropout(p) on each gate input path, nn/LSTM.scala)."""
+        p = getattr(self, "p", 0.0)
+        if not training or p <= 0.0 or rng is None:
+            return gates
+        keep = jax.random.bernoulli(rng, 1.0 - p, gates.shape)
+        return jnp.where(keep, gates / (1.0 - p), 0.0)
+
+    # a Cell used standalone maps {input, state-table} like BigDL; the
+    # common path is via Recurrent below.
+    def f(self, params, x, *, training=False, rng=None, **kw):
+        y, _ = self.step(params, x, self.init_state(x.shape[0], x.dtype),
+                         training=training, rng=rng)
+        return y
+
+
+def _uniform(rng, shape, stdv):
+    return jax.random.uniform(rng, shape, minval=-stdv, maxval=stdv, dtype=jnp.float32)
+
+
+class RnnCell(Cell):
+    """Elman cell: h' = act(W x + U h + b) (ref nn/RNN.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int, activation: Optional[Module] = None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        from bigdl_tpu.nn.activations import Tanh
+        self.activation = activation if activation is not None else Tanh()
+
+    def init(self, rng):
+        k = jax.random.split(rng, 4)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        return {"w_ih": _uniform(k[0], (self.input_size, self.hidden_size), stdv),
+                "w_hh": _uniform(k[1], (self.hidden_size, self.hidden_size), stdv),
+                "bias": _uniform(k[2], (self.hidden_size,), stdv)}
+
+    def init_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, params, x_t, h, *, training=False, rng=None):
+        h_new = self.activation.f({}, x_t @ params["w_ih"] + h @ params["w_hh"] + params["bias"])
+        return h_new, h_new
+
+
+class LSTM(Cell):
+    """LSTM cell with fused 4-gate matmul (ref nn/LSTM.scala, 210 LoC
+    compositional; here one GEMM per step feeds the MXU).  ``p`` is dropout
+    on the gate pre-activations (p=0 disables, the reference's default)."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.p = p  # dropout on the 4 gate inputs, as in the reference
+
+    def init(self, rng):
+        k = jax.random.split(rng, 3)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        H = self.hidden_size
+        return {"w_ih": _uniform(k[0], (self.input_size, 4 * H), stdv),
+                "w_hh": _uniform(k[1], (H, 4 * H), stdv),
+                "bias": _uniform(k[2], (4 * H,), stdv)}
+
+    def init_state(self, batch, dtype=jnp.float32):
+        H = self.hidden_size
+        return (jnp.zeros((batch, H), dtype), jnp.zeros((batch, H), dtype))
+
+    def step(self, params, x_t, state, *, training=False, rng=None):
+        h, c = state
+        H = self.hidden_size
+        gates = x_t @ params["w_ih"] + h @ params["w_hh"] + params["bias"]
+        gates = self._gate_dropout(gates, training, rng)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRU(Cell):
+    """GRU cell, fused 3-gate matmul (ref nn/GRU.scala)."""
+
+    def __init__(self, input_size: int, hidden_size: int, p: float = 0.0):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.p = p
+
+    def init(self, rng):
+        k = jax.random.split(rng, 3)
+        stdv = 1.0 / math.sqrt(self.hidden_size)
+        H = self.hidden_size
+        return {"w_ih": _uniform(k[0], (self.input_size, 3 * H), stdv),
+                "w_hh": _uniform(k[1], (H, 3 * H), stdv),
+                "bias": _uniform(k[2], (3 * H,), stdv)}
+
+    def init_state(self, batch, dtype=jnp.float32):
+        return jnp.zeros((batch, self.hidden_size), dtype)
+
+    def step(self, params, x_t, h, *, training=False, rng=None):
+        H = self.hidden_size
+        xi = x_t @ params["w_ih"] + params["bias"]
+        xi = self._gate_dropout(xi, training, rng)
+        hh = h @ params["w_hh"]
+        r = jax.nn.sigmoid(xi[:, :H] + hh[:, :H])
+        z = jax.nn.sigmoid(xi[:, H:2 * H] + hh[:, H:2 * H])
+        n = jnp.tanh(xi[:, 2 * H:] + r * hh[:, 2 * H:])
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+
+
+class Recurrent(Module):
+    """Unroll a cell over the time dim via lax.scan
+    (ref nn/Recurrent.scala).  Input (B, T, F) -> output (B, T, H)."""
+
+    def __init__(self, cell: Optional[Cell] = None):
+        super().__init__()
+        self.cell = cell
+        self.modules = [cell] if cell is not None else []
+
+    def add(self, cell: Cell) -> "Recurrent":
+        self.cell = cell
+        self.modules = [cell]
+        return self
+
+    def init(self, rng):
+        return {"cell": self.cell.init(rng)}
+
+    def f(self, params, x, *, training=False, rng=None, **kw):
+        B, T = x.shape[0], x.shape[1]
+        state0 = self.cell.init_state(B, x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)  # (T, B, F)
+        use_rng = rng is not None and getattr(self.cell, "p", 0.0) > 0.0 and training
+        keys = jax.random.split(rng, T) if use_rng else jnp.zeros((T, 2), dtype=jnp.uint32)
+
+        def body(state, inputs):
+            x_t, key = inputs
+            y_t, new_state = self.cell.step(
+                params["cell"], x_t, state, training=training,
+                rng=key if use_rng else None)
+            return new_state, y_t
+
+        _, ys = lax.scan(body, state0, (xs, keys))
+        return jnp.swapaxes(ys, 0, 1)  # (B, T, H)
+
+
+class BiRecurrent(Module):
+    """Bidirectional recurrence; merges fwd/bwd outputs with ``merge``
+    (default elementwise add, ref nn/BiRecurrent.scala)."""
+
+    def __init__(self, cell_fwd: Cell, cell_bwd: Optional[Cell] = None,
+                 merge: Optional[Module] = None):
+        super().__init__()
+        import copy
+        self.fwd = Recurrent(cell_fwd)
+        self.bwd = Recurrent(cell_bwd if cell_bwd is not None else copy.deepcopy(cell_fwd))
+        self.merge = merge if merge is not None else CAddTable()
+        self.modules = [self.fwd, self.bwd]
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"fwd": self.fwd.init(k1), "bwd": self.bwd.init(k2)}
+
+    def f(self, params, x, **kw):
+        y_f = self.fwd.f(params["fwd"], x)
+        y_b = jnp.flip(self.bwd.f(params["bwd"], jnp.flip(x, axis=1)), axis=1)
+        return self.merge.f({}, [y_f, y_b])
+
+
+class TimeDistributed(Module):
+    """Apply an inner module independently at every timestep by folding
+    time into batch (ref nn/TimeDistributed.scala) — one big batched GEMM
+    instead of T small ones."""
+
+    def __init__(self, module: Module):
+        super().__init__()
+        self.module = module
+        self.modules = [module]
+
+    def init(self, rng):
+        return {"module": self.module.init(rng)}
+
+    def init_buffers(self):
+        return {"module": self.module.init_buffers()}
+
+    def apply(self, params, x, *, buffers=None, training=False, rng=None):
+        B, T = x.shape[0], x.shape[1]
+        flat = x.reshape((B * T,) + x.shape[2:])
+        y, b = self.module.apply(params["module"], flat,
+                                 buffers=(buffers or {}).get("module", {}),
+                                 training=training, rng=rng)
+        return y.reshape((B, T) + y.shape[1:]), {"module": b}

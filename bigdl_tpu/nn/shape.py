@@ -1,0 +1,270 @@
+"""Shape-manipulation layers (ref nn/: Reshape, InferReshape, View, Squeeze,
+Unsqueeze, Transpose, Replicate, Padding, SpatialZeroPadding, Narrow, Select,
+Index, MaskedSelect, Reverse, Contiguous, Copy, Identity, Echo).
+
+All dims are 1-based as in Torch/BigDL.  These are metadata ops: XLA folds
+most of them into the surrounding computation for free (the reference's
+copy/contiguity machinery in DenseTensor.scala has no runtime cost here).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.nn._util import to_axis
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+class Identity(Module):
+    def f(self, params, x, **kw):
+        return x
+
+
+class Echo(Module):
+    """Identity that prints its input's shape (ref nn/Echo.scala) — debug aid."""
+
+    def f(self, params, x, **kw):
+        jax.debug.print("Echo: shape {}", x.shape if hasattr(x, "shape") else None)
+        return x
+
+
+class Contiguous(Module):
+    """No-op under XLA (ref nn/Contiguous.scala — arrays are always packed)."""
+
+    def f(self, params, x, **kw):
+        return x
+
+
+class Copy(Module):
+    def f(self, params, x, **kw):
+        return jnp.array(x)
+
+
+class Reshape(Module):
+    """Reshape non-batch dims to ``size``; batch_mode None auto-detects a
+    leading batch dim as Torch does (ref nn/Reshape.scala)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: Optional[bool] = None):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+        self._n = 1
+        for s in self.size:
+            self._n *= s
+
+    def f(self, params, x, **kw):
+        # Torch rule (nn/Reshape.scala): explicit batch_mode wins; with
+        # batch_mode None, input is non-batch only when element counts match
+        # AND the first dim isn't a singleton batch dim (so a size-1 batch
+        # keeps its batch axis).
+        if self.batch_mode is False or (
+                self.batch_mode is None and x.size == self._n and x.shape[0] != 1):
+            return x.reshape(self.size)
+        return x.reshape((x.shape[0],) + self.size)
+
+
+class InferReshape(Module):
+    """Reshape with -1 (infer) and 0 (copy input dim) entries
+    (ref nn/InferReshape.scala)."""
+
+    def __init__(self, size: Sequence[int], batch_mode: bool = False):
+        super().__init__()
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def f(self, params, x, **kw):
+        in_shape = x.shape[1:] if self.batch_mode else x.shape
+        out = []
+        for i, s in enumerate(self.size):
+            if s == 0:
+                out.append(in_shape[i])
+            else:
+                out.append(s)
+        if self.batch_mode:
+            return x.reshape((x.shape[0],) + tuple(out))
+        return x.reshape(tuple(out))
+
+
+class View(Module):
+    """View with fixed sizes; -1 allowed (ref nn/View.scala)."""
+
+    def __init__(self, *sizes: int):
+        super().__init__()
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(sizes)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n: int) -> "View":
+        self.num_input_dims = n
+        return self
+
+    def f(self, params, x, **kw):
+        n = 1
+        for s in self.sizes:
+            n *= s
+        if n > 0 and (x.size != n or x.shape[0] == 1):
+            return x.reshape((x.shape[0],) + self.sizes)  # leading batch dim
+        return x.reshape(self.sizes)
+
+
+class Squeeze(Module):
+    def __init__(self, dim: Optional[int] = None, num_input_dims: int = -1):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def f(self, params, x, **kw):
+        if self.dim is None:
+            return jnp.squeeze(x)
+        nid = self.num_input_dims if self.num_input_dims > 0 else None
+        axis = to_axis(self.dim, x.ndim, nid)
+        return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+class Unsqueeze(Module):
+    def __init__(self, pos: int, num_input_dims: int = -1):
+        super().__init__()
+        self.pos = pos
+        self.num_input_dims = num_input_dims
+
+    def f(self, params, x, **kw):
+        nid = self.num_input_dims if self.num_input_dims > 0 else None
+        axis = to_axis(self.pos, x.ndim + 1, (nid + 1) if nid else None)
+        return jnp.expand_dims(x, axis=axis)
+
+
+class Transpose(Module):
+    """Sequence of pairwise 1-based dim swaps (ref nn/Transpose.scala)."""
+
+    def __init__(self, permutations: Sequence[tuple[int, int]]):
+        super().__init__()
+        self.permutations = [tuple(p) for p in permutations]
+
+    def f(self, params, x, **kw):
+        for d1, d2 in self.permutations:
+            x = jnp.swapaxes(x, to_axis(d1, x.ndim), to_axis(d2, x.ndim))
+        return x
+
+
+class Replicate(Module):
+    """Insert a new dim of size n_features at 1-based position dim
+    (ref nn/Replicate.scala)."""
+
+    def __init__(self, n_features: int, dim: int = 1, n_dim: int = -1):
+        super().__init__()
+        self.n_features = n_features
+        self.dim = dim
+        self.n_dim = n_dim
+
+    def f(self, params, x, **kw):
+        nid = self.n_dim if self.n_dim > 0 else None
+        axis = to_axis(self.dim, x.ndim + 1, (nid + 1) if nid else None)
+        return jnp.repeat(jnp.expand_dims(x, axis), self.n_features, axis=axis)
+
+
+class Padding(Module):
+    """Pad ``pad`` slots (left if negative) along a 1-based dim with
+    ``value`` (ref nn/Padding.scala)."""
+
+    def __init__(self, dim: int, pad: int, n_input_dim: int = -1,
+                 value: float = 0.0, n_index: int = 1):
+        super().__init__()
+        self.dim = dim
+        self.pad = pad
+        self.n_input_dim = n_input_dim
+        self.value = value
+
+    def f(self, params, x, **kw):
+        nid = self.n_input_dim if self.n_input_dim > 0 else None
+        axis = to_axis(self.dim, x.ndim, nid)
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, widths, constant_values=self.value)
+
+
+class SpatialZeroPadding(Module):
+    def __init__(self, pad_left: int, pad_right: int = None,
+                 pad_top: int = None, pad_bottom: int = None):
+        super().__init__()
+        self.pad_left = pad_left
+        self.pad_right = pad_right if pad_right is not None else pad_left
+        self.pad_top = pad_top if pad_top is not None else pad_left
+        self.pad_bottom = pad_bottom if pad_bottom is not None else pad_left
+
+    def f(self, params, x, **kw):
+        widths = [(0, 0)] * (x.ndim - 2) + \
+            [(self.pad_top, self.pad_bottom), (self.pad_left, self.pad_right)]
+        return jnp.pad(x, widths)
+
+
+class Narrow(Module):
+    """Slice [offset, offset+length) along a 1-based dim; negative offset
+    counts from the end (ref nn/Narrow.scala)."""
+
+    def __init__(self, dimension: int, offset: int, length: int = 1):
+        super().__init__()
+        self.dimension = dimension
+        self.offset = offset
+        self.length = length
+
+    def f(self, params, x, **kw):
+        axis = to_axis(self.dimension, x.ndim)
+        size = x.shape[axis]
+        start = self.offset - 1 if self.offset > 0 else size + self.offset
+        length = self.length if self.length > 0 else size - start + self.length + 1
+        return jax.lax.slice_in_dim(x, start, start + length, axis=axis)
+
+
+class Select(Module):
+    """Select one 1-based index along a 1-based dim, squeezing it
+    (ref nn/Select.scala)."""
+
+    def __init__(self, dimension: int, index: int):
+        super().__init__()
+        self.dimension = dimension
+        self.index = index
+
+    def f(self, params, x, **kw):
+        axis = to_axis(self.dimension, x.ndim)
+        idx = self.index - 1 if self.index > 0 else x.shape[axis] + self.index
+        return jax.lax.index_in_dim(x, idx, axis, keepdims=False)
+
+
+class Index(Module):
+    """Gather along a 1-based dim with a 1-based index tensor from a table
+    {tensor, indices} (ref nn/Index.scala)."""
+
+    def __init__(self, dimension: int):
+        super().__init__()
+        self.dimension = dimension
+
+    def f(self, params, x, **kw):
+        t, idx = (x.to_seq() if isinstance(x, Table) else list(x))
+        axis = to_axis(self.dimension, t.ndim)
+        return jnp.take(t, idx.astype(jnp.int32) - 1, axis=axis)
+
+
+class MaskedSelect(Module):
+    """Select elements where mask is nonzero, flattened
+    (ref nn/MaskedSelect.scala).  The output length is data-dependent, so
+    this op cannot live under jax.jit (no dynamic shapes in XLA); it is
+    evaluated eagerly — the same reason it has no SPMD story in any
+    framework."""
+
+    def f(self, params, x, **kw):
+        t, mask = (x.to_seq() if isinstance(x, Table) else list(x))
+        import numpy as np
+        return jnp.asarray(np.asarray(t)[np.asarray(mask) != 0])
+
+
+class Reverse(Module):
+    def __init__(self, dimension: int = 1):
+        super().__init__()
+        self.dimension = dimension
+
+    def f(self, params, x, **kw):
+        return jnp.flip(x, axis=to_axis(self.dimension, x.ndim))

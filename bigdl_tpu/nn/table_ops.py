@@ -1,0 +1,128 @@
+"""Elementwise table-combining layers (ref nn/CAddTable.scala etc.) and
+per-element reductions over one tensor (ref nn/Sum.scala, Mean, Max, Min).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from bigdl_tpu.nn._util import to_axis
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.utils.table import Table
+
+
+def _seq(x):
+    return x.to_seq() if isinstance(x, Table) else list(x)
+
+
+class CAddTable(Module):
+    def __init__(self, inplace: bool = False):
+        super().__init__()
+
+    def f(self, params, x, **kw):
+        xs = _seq(x)
+        out = xs[0]
+        for t in xs[1:]:
+            out = out + t
+        return out
+
+
+class CSubTable(Module):
+    def f(self, params, x, **kw):
+        a, b = _seq(x)
+        return a - b
+
+
+class CMulTable(Module):
+    def f(self, params, x, **kw):
+        xs = _seq(x)
+        out = xs[0]
+        for t in xs[1:]:
+            out = out * t
+        return out
+
+
+class CDivTable(Module):
+    def f(self, params, x, **kw):
+        a, b = _seq(x)
+        return a / b
+
+
+class CMaxTable(Module):
+    def f(self, params, x, **kw):
+        xs = _seq(x)
+        out = xs[0]
+        for t in xs[1:]:
+            out = jnp.maximum(out, t)
+        return out
+
+
+class CMinTable(Module):
+    def f(self, params, x, **kw):
+        xs = _seq(x)
+        out = xs[0]
+        for t in xs[1:]:
+            out = jnp.minimum(out, t)
+        return out
+
+
+class Sum(Module):
+    """Sum over a 1-based dim; size_average divides by dim size; squeeze
+    drops the dim (ref nn/Sum.scala)."""
+
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 size_average: bool = False, squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.size_average = size_average
+        self.squeeze = squeeze
+
+    def f(self, params, x, **kw):
+        nid = self.n_input_dims if self.n_input_dims > 0 else None
+        axis = to_axis(self.dimension, x.ndim, nid)
+        y = jnp.sum(x, axis=axis, keepdims=not self.squeeze)
+        if self.size_average:
+            y = y / x.shape[axis]
+        return y
+
+
+class Mean(Module):
+    def __init__(self, dimension: int = 1, n_input_dims: int = -1,
+                 squeeze: bool = True):
+        super().__init__()
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+        self.squeeze = squeeze
+
+    def f(self, params, x, **kw):
+        nid = self.n_input_dims if self.n_input_dims > 0 else None
+        axis = to_axis(self.dimension, x.ndim, nid)
+        return jnp.mean(x, axis=axis, keepdims=not self.squeeze)
+
+
+class Max(Module):
+    """Max values over a 1-based dim (ref nn/Max.scala)."""
+
+    def __init__(self, dim: int = 1, num_input_dims: int = -1):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def f(self, params, x, **kw):
+        nid = self.num_input_dims if self.num_input_dims > 0 else None
+        axis = to_axis(self.dim, x.ndim, nid)
+        return jnp.max(x, axis=axis)
+
+
+class Min(Module):
+    def __init__(self, dim: int = 1, num_input_dims: int = -1):
+        super().__init__()
+        self.dim = dim
+        self.num_input_dims = num_input_dims
+
+    def f(self, params, x, **kw):
+        nid = self.num_input_dims if self.num_input_dims > 0 else None
+        axis = to_axis(self.dim, x.ndim, nid)
+        return jnp.min(x, axis=axis)

@@ -1,0 +1,4 @@
+from bigdl_tpu.utils.table import Table, T
+from bigdl_tpu.utils.engine import Engine
+
+__all__ = ["Table", "T", "Engine"]

@@ -1,0 +1,166 @@
+"""Engine: topology discovery and runtime configuration.
+
+TPU-native rebuild of the reference's ``utils/Engine.scala`` (84-445).  The
+reference derives (nodeNumber, coresPerNode) from the Spark conf and runs
+``coresPerNode`` thread-replicas per executor, each pinned to one MKL thread.
+On TPU the mapping is:
+
+    one Spark executor ("node")      -> one JAX process (host)
+    one core-thread model replica    -> one TPU chip (one mesh slot)
+    Engine.init / checkSingleton     -> jax.distributed.initialize + device
+                                        enumeration (one process owns the
+                                        host's chips)
+    Engine.default / Engine.model    -> host thread pool for the input
+                                        pipeline; on-device parallelism is
+                                        XLA's job.
+
+There are no thread-replica semantics to reproduce on device: XLA batches
+natively, so ``core_number`` counts *local devices*, not threads.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Callable, Optional, Sequence
+
+
+class ThreadPool:
+    """Host-side task pool (ref utils/ThreadPool.scala:92-168).
+
+    Used by the data pipeline for threaded prefetch/decode, the role
+    ``Engine.default`` played for the reference's coarse host tasks.  The
+    straggler-timeout variant ``invoke_and_wait2`` is kept for API parity,
+    though under SPMD lockstep on TPU it only gates *host* work.
+    """
+
+    def __init__(self, size: int):
+        self._size = size
+        self._pool = ThreadPoolExecutor(max_workers=size, thread_name_prefix="bigdl-tpu")
+
+    @property
+    def size(self) -> int:
+        return self._size
+
+    def invoke(self, tasks: Sequence[Callable]) -> list[Future]:
+        return [self._pool.submit(t) for t in tasks]
+
+    def invoke_and_wait(self, tasks: Sequence[Callable]) -> list:
+        return [f.result() for f in self.invoke(tasks)]
+
+    def invoke_and_wait2(self, tasks: Sequence[Callable], timeout: Optional[float] = None) -> list[Future]:
+        """Submit all tasks, wait up to ``timeout`` seconds; returns futures
+        (some possibly unfinished — the caller decides what to drop)."""
+        futures = self.invoke(tasks)
+        for f in futures:
+            try:
+                f.result(timeout=timeout)
+            except Exception:  # noqa: BLE001 - timeout or task error: caller inspects
+                pass
+        return futures
+
+    def sync(self, futures: Sequence[Future]) -> None:
+        for f in futures:
+            f.result()
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class _EngineState:
+    def __init__(self):
+        self.initialized = False
+        self.node_number = 1
+        self.core_number = 1
+        self.default_pool: Optional[ThreadPool] = None
+        self.model_pool: Optional[ThreadPool] = None
+        self.lock = threading.Lock()
+        self.singleton_claimed = False
+
+
+_state = _EngineState()
+
+
+class Engine:
+    """Singleton runtime facade (ref utils/Engine.scala:84-99,142-146)."""
+
+    @staticmethod
+    def init(node_number: Optional[int] = None, core_number: Optional[int] = None) -> None:
+        """Discover topology.  With no args: local mode uses the current
+        process's devices (ref Engine.init no-arg, utils/Engine.scala:84-99);
+        in a multi-host job call ``jax.distributed.initialize`` first (the
+        analog of launching on Spark) and Engine picks up process/device
+        counts from JAX.
+        """
+        import jax
+
+        with _state.lock:
+            if node_number is None:
+                node_number = jax.process_count()
+            if core_number is None:
+                if os.environ.get("DL_CORE_NUMBER"):
+                    core_number = int(os.environ["DL_CORE_NUMBER"])
+                else:
+                    core_number = jax.local_device_count()
+            _state.node_number = node_number
+            _state.core_number = core_number
+            host_threads = int(os.environ.get("BIGDL_TPU_DEFAULT_POOL_SIZE", str(max(os.cpu_count() or 4, 4))))
+            if _state.default_pool is None:
+                _state.default_pool = ThreadPool(host_threads)
+            if _state.model_pool is None:
+                _state.model_pool = ThreadPool(core_number)
+            _state.initialized = True
+
+    @staticmethod
+    def node_number() -> int:
+        Engine._require_init()
+        return _state.node_number
+
+    @staticmethod
+    def core_number() -> int:
+        Engine._require_init()
+        return _state.core_number
+
+    @staticmethod
+    def default() -> ThreadPool:
+        Engine._require_init()
+        return _state.default_pool  # type: ignore[return-value]
+
+    @staticmethod
+    def model() -> ThreadPool:
+        Engine._require_init()
+        return _state.model_pool  # type: ignore[return-value]
+
+    @staticmethod
+    def check_singleton() -> bool:
+        """Atomic guard: only one Engine owner per process (ref
+        utils/Engine.scala:164-174 — one BigDL task per executor JVM; here,
+        one trainer per process, since the process owns the host's TPUs)."""
+        if os.environ.get("BIGDL_TPU_CHECK_SINGLETON", "1") in ("0", "false"):
+            return True
+        with _state.lock:
+            if _state.singleton_claimed:
+                return False
+            _state.singleton_claimed = True
+            return True
+
+    @staticmethod
+    def reset() -> None:
+        """Test hook: clear init + singleton state."""
+        with _state.lock:
+            _state.initialized = False
+            _state.singleton_claimed = False
+            _state.node_number = 1
+            _state.core_number = 1
+
+    @staticmethod
+    def is_initialized() -> bool:
+        return _state.initialized
+
+    @staticmethod
+    def _require_init() -> None:
+        if not _state.initialized:
+            raise RuntimeError(
+                "Engine.init() must be called before use. In a multi-host job, "
+                "call jax.distributed.initialize() first."
+            )

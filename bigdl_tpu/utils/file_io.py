@@ -1,0 +1,49 @@
+"""Module / object persistence (ref utils/File.scala:26-122 — java
+serialization with hdfs: support; here pickle with numpy-materialized
+arrays, the Python-native analog).  The orbax-style training checkpoints
+live in ``bigdl_tpu.optim.checkpoint``; this is the ``Module.save`` /
+``Module.load`` whole-model path (ref nn/Module.scala:27-39)."""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(lambda a: np.asarray(a), tree)
+
+
+def save(obj: Any, path: str, overwrite: bool = False) -> None:
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(f"{path} exists; pass overwrite=True")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(obj, f)
+    os.replace(tmp, path)
+
+
+def load(path: str) -> Any:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def save_module(module, path: str, overwrite: bool = False) -> None:
+    """Persist a module (hyperparams + params + buffers) as one file."""
+    state = {
+        "module": module,  # picklable: jit caches dropped via __getstate__
+        "params": _to_host(module.params),
+        "buffers": _to_host(module.buffers),
+    }
+    save(state, path, overwrite=overwrite)
+
+
+def load_module(path: str):
+    state = load(path)
+    module = state["module"]
+    module.params = jax.tree_util.tree_map(lambda a: a, state["params"])
+    module.buffers = state["buffers"]
+    return module

@@ -1,0 +1,166 @@
+"""Torch-compatible Mersenne-Twister RNG (host side).
+
+Rebuild of the reference's ``utils/RandomGenerator.scala:23-265``, which is a
+faithful MT19937 matching Torch7 so that layer initializations and test
+oracles are bit-reproducible against Torch.  We implement the *standard*
+MT19937 algorithm (Matsumoto & Nishimura, public) with Torch's seeding and
+double-generation conventions:
+
+- state N=624, M=397, seeded by the LCG ``s[i] = 1812433253*(s[i-1] ^ (s[i-1]>>30)) + i``
+- ``random()`` draws 53-bit doubles in [0,1) via (a*2^26+b)/2^53
+- ``normal`` uses the polar (Marsaglia) method with one cached value,
+  matching Torch's ``torch.normal`` consumption order.
+
+This RNG runs on host (numpy) and seeds parameter init; on-device stochastic
+ops (Dropout) use ``jax.random`` keys derived from it.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+_N = 624
+_M = 397
+_MATRIX_A = 0x9908B0DF
+_UPPER_MASK = 0x80000000
+_LOWER_MASK = 0x7FFFFFFF
+
+
+class RandomGenerator:
+    def __init__(self, seed: int = 5489):
+        self._mt = np.zeros(_N, dtype=np.uint64)
+        self._mti = _N + 1
+        self._normal_cached = None
+        self.set_seed(seed)
+
+    def set_seed(self, seed: int) -> "RandomGenerator":
+        self._seed = seed
+        mt = self._mt
+        mt[0] = seed & 0xFFFFFFFF
+        for i in range(1, _N):
+            mt[i] = (1812433253 * (mt[i - 1] ^ (mt[i - 1] >> np.uint64(30))) + i) & 0xFFFFFFFF
+        self._mti = _N
+        self._normal_cached = None
+        return self
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    def _generate(self) -> None:
+        mt = self._mt.astype(np.uint64)
+        mag01 = np.array([0, _MATRIX_A], dtype=np.uint64)
+        # standard block update, vectorized in three strips
+        y = (mt[:_N - _M] & _UPPER_MASK) | (mt[1:_N - _M + 1] & _LOWER_MASK)
+        mt[:_N - _M] = mt[_M:] ^ (y >> np.uint64(1)) ^ mag01[(y & np.uint64(1)).astype(np.int64)]
+        y = (mt[_N - _M:_N - 1] & _UPPER_MASK) | (mt[_N - _M + 1:] & _LOWER_MASK)
+        mt[_N - _M:_N - 1] = mt[:_M - 1] ^ (y >> np.uint64(1)) ^ mag01[(y & np.uint64(1)).astype(np.int64)]
+        y = (mt[_N - 1] & np.uint64(_UPPER_MASK)) | (mt[0] & np.uint64(_LOWER_MASK))
+        mt[_N - 1] = mt[_M - 1] ^ (y >> np.uint64(1)) ^ mag01[int(y & np.uint64(1))]
+        self._mt = mt
+        self._mti = 0
+
+    def _next_uint32(self) -> int:
+        if self._mti >= _N:
+            self._generate()
+        y = int(self._mt[self._mti])
+        self._mti += 1
+        y ^= y >> 11
+        y ^= (y << 7) & 0x9D2C5680
+        y &= 0xFFFFFFFF
+        y ^= (y << 15) & 0xEFC60000
+        y &= 0xFFFFFFFF
+        y ^= y >> 18
+        return y
+
+    # -- draws -------------------------------------------------------------
+    def random_int(self) -> int:
+        return self._next_uint32()
+
+    def random(self) -> float:
+        """53-bit double in [0,1)."""
+        a = self._next_uint32() >> 5
+        b = self._next_uint32() >> 6
+        return (a * 67108864.0 + b) * (1.0 / 9007199254740992.0)
+
+    def uniform(self, a: float = 0.0, b: float = 1.0) -> float:
+        return self.random() * (b - a) + a
+
+    def normal(self, mean: float = 0.0, stdv: float = 1.0) -> float:
+        if self._normal_cached is not None:
+            v = self._normal_cached
+            self._normal_cached = None
+            return mean + stdv * v
+        while True:
+            u = 2.0 * self.random() - 1.0
+            v = 2.0 * self.random() - 1.0
+            s = u * u + v * v
+            if 0.0 < s < 1.0:
+                break
+        mult = np.sqrt(-2.0 * np.log(s) / s)
+        self._normal_cached = v * mult
+        return mean + stdv * (u * mult)
+
+    def exponential(self, lam: float) -> float:
+        return -1.0 / lam * np.log(1.0 - self.random())
+
+    def cauchy(self, median: float, sigma: float) -> float:
+        return median + sigma * np.tan(np.pi * (self.random() - 0.5))
+
+    def log_normal(self, mean: float, stdv: float) -> float:
+        zm = mean * mean
+        zs = stdv * stdv
+        return float(np.exp(self.normal(np.log(zm / np.sqrt(zs + zm)), np.sqrt(np.log(zs / zm + 1)))))
+
+    def geometric(self, p: float) -> int:
+        return int(np.log(1.0 - self.random()) / np.log(p)) + 1
+
+    def bernoulli(self, p: float) -> bool:
+        return self.random() <= p
+
+    # -- array helpers (for init parity tests) ----------------------------
+    def uniform_array(self, n: int, a: float = 0.0, b: float = 1.0) -> np.ndarray:
+        return np.array([self.uniform(a, b) for _ in range(n)])
+
+    def normal_array(self, n: int, mean: float = 0.0, stdv: float = 1.0) -> np.ndarray:
+        return np.array([self.normal(mean, stdv) for _ in range(n)])
+
+    def randperm(self, n: int) -> np.ndarray:
+        """1-based random permutation (Torch randperm semantics)."""
+        perm = np.arange(1, n + 1)
+        for i in range(n - 1, 0, -1):
+            j = int(self.random() * (i + 1))
+            perm[i], perm[j] = perm[j], perm[i]
+        return perm
+
+
+class _ThreadLocalRNG(threading.local):
+    def __init__(self):
+        self.gen = RandomGenerator()
+
+
+_tls = _ThreadLocalRNG()
+
+
+class RNG:
+    """Global thread-shared generator facade (ref RandomGenerator.scala RNG)."""
+
+    @staticmethod
+    def current() -> RandomGenerator:
+        return _tls.gen
+
+    @staticmethod
+    def set_seed(seed: int) -> None:
+        _tls.gen.set_seed(seed)
+
+    @staticmethod
+    def uniform(a: float = 0.0, b: float = 1.0) -> float:
+        return _tls.gen.uniform(a, b)
+
+    @staticmethod
+    def normal(mean: float = 0.0, stdv: float = 1.0) -> float:
+        return _tls.gen.normal(mean, stdv)
+
+    @staticmethod
+    def bernoulli(p: float) -> bool:
+        return _tls.gen.bernoulli(p)

@@ -1,0 +1,40 @@
+"""Test configuration: force an 8-virtual-device CPU platform so multi-chip
+sharding paths are exercised without TPU pods (the analog of the reference's
+simulated-multinode trick: DistriOptimizerSpec runs 4 "nodes" as 4
+partitions in one local[1] JVM, optim/DistriOptimizerSpec.scala:39-43).
+
+Note: the environment's sitecustomize imports jax at interpreter start with
+JAX_PLATFORMS=axon, so env vars are too late here — we switch platform via
+jax.config before the first backend use instead.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_engine():
+    from bigdl_tpu.utils.engine import Engine
+    Engine.reset()
+    os.environ["BIGDL_TPU_CHECK_SINGLETON"] = "0"
+    yield
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(42)
+
+
+@pytest.fixture
+def nprng():
+    return np.random.RandomState(42)

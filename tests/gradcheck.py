@@ -1,0 +1,29 @@
+"""Finite-difference gradient checker (ref nn/GradientChecker.scala:32-60).
+
+Compares jax.grad analytic gradients against central differences at sampled
+points.  float32 on CPU -> loose-ish tolerances, like the reference's 1e-3.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_gradient(fn, x, eps: float = 1e-2, rtol: float = 5e-2,
+                   atol: float = 5e-3, n_samples: int = 12, seed: int = 0) -> bool:
+    """fn: array -> scalar. Returns True if sampled FD grads match jax.grad."""
+    x = jnp.asarray(x, dtype=jnp.float32)
+    analytic = np.asarray(jax.grad(fn)(x)).reshape(-1)
+    flat = np.asarray(x, dtype=np.float64).reshape(-1)
+    rng = np.random.RandomState(seed)
+    idxs = rng.choice(flat.size, size=min(n_samples, flat.size), replace=False)
+    for i in idxs:
+        xp = flat.copy()
+        xp[i] += eps
+        xm = flat.copy()
+        xm[i] -= eps
+        fp = float(fn(jnp.asarray(xp.reshape(x.shape), dtype=jnp.float32)))
+        fm = float(fn(jnp.asarray(xm.reshape(x.shape), dtype=jnp.float32)))
+        fd = (fp - fm) / (2 * eps)
+        if not np.isclose(fd, analytic[i], rtol=rtol, atol=atol):
+            return False
+    return True

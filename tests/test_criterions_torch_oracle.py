@@ -1,0 +1,186 @@
+"""Criterion oracle tests vs PyTorch losses (targets 1-based on our side,
+per Torch/BigDL convention)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+
+TOL = dict(rtol=1e-4, atol=1e-5)
+
+
+def _logits(nprng, n=4, c=5):
+    return nprng.randn(n, c).astype(np.float32)
+
+
+def test_class_nll(nprng):
+    logp = np.log(np.abs(_logits(nprng)) + 0.1)
+    logp = logp - logp.max()
+    target = np.array([1, 3, 5, 2], dtype=np.float32)
+    ours = nn.ClassNLLCriterion().forward(jnp.asarray(logp), jnp.asarray(target))
+    ref = F.nll_loss(torch.from_numpy(logp), torch.from_numpy(target).long() - 1)
+    np.testing.assert_allclose(float(ours), float(ref), **TOL)
+
+
+def test_class_nll_weights(nprng):
+    logp = _logits(nprng)
+    target = np.array([1, 3, 5, 2], dtype=np.float32)
+    w = nprng.rand(5).astype(np.float32)
+    ours = nn.ClassNLLCriterion(weights=w).forward(jnp.asarray(logp), jnp.asarray(target))
+    ref = F.nll_loss(torch.from_numpy(logp), torch.from_numpy(target).long() - 1,
+                     weight=torch.from_numpy(w))
+    np.testing.assert_allclose(float(ours), float(ref), **TOL)
+
+
+def test_cross_entropy(nprng):
+    x = _logits(nprng)
+    target = np.array([2, 1, 4, 5], dtype=np.float32)
+    ours = nn.CrossEntropyCriterion().forward(jnp.asarray(x), jnp.asarray(target))
+    ref = F.cross_entropy(torch.from_numpy(x), torch.from_numpy(target).long() - 1)
+    np.testing.assert_allclose(float(ours), float(ref), **TOL)
+
+
+def test_mse(nprng):
+    x, y = nprng.randn(3, 4).astype(np.float32), nprng.randn(3, 4).astype(np.float32)
+    ours = nn.MSECriterion().forward(jnp.asarray(x), jnp.asarray(y))
+    np.testing.assert_allclose(float(ours), float(F.mse_loss(torch.from_numpy(x), torch.from_numpy(y))), **TOL)
+
+
+def test_abs(nprng):
+    x, y = nprng.randn(3, 4).astype(np.float32), nprng.randn(3, 4).astype(np.float32)
+    ours = nn.AbsCriterion().forward(jnp.asarray(x), jnp.asarray(y))
+    np.testing.assert_allclose(float(ours), float(F.l1_loss(torch.from_numpy(x), torch.from_numpy(y))), **TOL)
+
+
+def test_bce(nprng):
+    x = nprng.rand(3, 4).astype(np.float32) * 0.9 + 0.05
+    y = (nprng.rand(3, 4) > 0.5).astype(np.float32)
+    ours = nn.BCECriterion().forward(jnp.asarray(x), jnp.asarray(y))
+    ref = F.binary_cross_entropy(torch.from_numpy(x), torch.from_numpy(y))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_kl_div(nprng):
+    logp = F.log_softmax(torch.from_numpy(_logits(nprng)), dim=-1)
+    q = F.softmax(torch.from_numpy(_logits(nprng)), dim=-1)
+    ours = nn.DistKLDivCriterion().forward(jnp.asarray(logp.numpy()), jnp.asarray(q.numpy()))
+    ref = F.kl_div(logp, q, reduction="mean")
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_smooth_l1(nprng):
+    x, y = nprng.randn(3, 4).astype(np.float32), nprng.randn(3, 4).astype(np.float32)
+    ours = nn.SmoothL1Criterion().forward(jnp.asarray(x), jnp.asarray(y))
+    np.testing.assert_allclose(float(ours), float(F.smooth_l1_loss(torch.from_numpy(x), torch.from_numpy(y))), **TOL)
+
+
+def test_margin(nprng):
+    x = nprng.randn(6).astype(np.float32)
+    y = np.sign(nprng.randn(6)).astype(np.float32)
+    ours = nn.MarginCriterion().forward(jnp.asarray(x), jnp.asarray(y))
+    expected = np.maximum(0, 1.0 - x * y).mean()
+    np.testing.assert_allclose(float(ours), expected, **TOL)
+
+
+def test_multi_margin(nprng):
+    x = _logits(nprng)
+    target = np.array([1, 3, 5, 2], dtype=np.float32)
+    ours = nn.MultiMarginCriterion().forward(jnp.asarray(x), jnp.asarray(target))
+    ref = F.multi_margin_loss(torch.from_numpy(x), torch.from_numpy(target).long() - 1)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_multilabel_soft_margin(nprng):
+    x = _logits(nprng)
+    y = (nprng.rand(4, 5) > 0.5).astype(np.float32)
+    ours = nn.MultiLabelSoftMarginCriterion().forward(jnp.asarray(x), jnp.asarray(y))
+    ref = F.multilabel_soft_margin_loss(torch.from_numpy(x), torch.from_numpy(y))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_soft_margin(nprng):
+    x = nprng.randn(3, 4).astype(np.float32)
+    y = np.sign(nprng.randn(3, 4)).astype(np.float32)
+    ours = nn.SoftMarginCriterion().forward(jnp.asarray(x), jnp.asarray(y))
+    ref = F.soft_margin_loss(torch.from_numpy(x), torch.from_numpy(y))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_hinge_embedding(nprng):
+    x = nprng.rand(6).astype(np.float32)
+    y = np.sign(nprng.randn(6)).astype(np.float32)
+    ours = nn.HingeEmbeddingCriterion(margin=1.0).forward(jnp.asarray(x), jnp.asarray(y))
+    ref = F.hinge_embedding_loss(torch.from_numpy(x), torch.from_numpy(y))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_cosine_embedding(nprng):
+    x1 = nprng.randn(4, 6).astype(np.float32)
+    x2 = nprng.randn(4, 6).astype(np.float32)
+    y = np.sign(nprng.randn(4)).astype(np.float32)
+    ours = nn.CosineEmbeddingCriterion(margin=0.0).forward(
+        [jnp.asarray(x1), jnp.asarray(x2)], jnp.asarray(y))
+    ref = F.cosine_embedding_loss(torch.from_numpy(x1), torch.from_numpy(x2), torch.from_numpy(y))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_margin_ranking(nprng):
+    x1 = nprng.randn(5).astype(np.float32)
+    x2 = nprng.randn(5).astype(np.float32)
+    y = np.sign(nprng.randn(5)).astype(np.float32)
+    ours = nn.MarginRankingCriterion(margin=0.5).forward(
+        [jnp.asarray(x1), jnp.asarray(x2)], jnp.asarray(y))
+    ref = F.margin_ranking_loss(torch.from_numpy(x1), torch.from_numpy(x2),
+                                torch.from_numpy(y), margin=0.5)
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_l1_cost(nprng):
+    x = nprng.randn(3, 4).astype(np.float32)
+    ours = nn.L1Cost().forward(jnp.asarray(x), None)
+    np.testing.assert_allclose(float(ours), np.abs(x).sum(), rtol=1e-4)
+
+
+def test_parallel_criterion(nprng):
+    x1 = nprng.randn(3, 4).astype(np.float32)
+    x2 = nprng.randn(3, 4).astype(np.float32)
+    y1 = nprng.randn(3, 4).astype(np.float32)
+    y2 = nprng.randn(3, 4).astype(np.float32)
+    pc = nn.ParallelCriterion().add(nn.MSECriterion(), 0.3).add(nn.AbsCriterion(), 0.7)
+    ours = pc.forward([jnp.asarray(x1), jnp.asarray(x2)], [jnp.asarray(y1), jnp.asarray(y2)])
+    ref = 0.3 * F.mse_loss(torch.from_numpy(x1), torch.from_numpy(y1)) + \
+        0.7 * F.l1_loss(torch.from_numpy(x2), torch.from_numpy(y2))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_multi_criterion(nprng):
+    x = nprng.randn(3, 4).astype(np.float32)
+    y = nprng.randn(3, 4).astype(np.float32)
+    mc = nn.MultiCriterion().add(nn.MSECriterion(), 0.5).add(nn.AbsCriterion(), 2.0)
+    ours = mc.forward(jnp.asarray(x), jnp.asarray(y))
+    ref = 0.5 * F.mse_loss(torch.from_numpy(x), torch.from_numpy(y)) + \
+        2.0 * F.l1_loss(torch.from_numpy(x), torch.from_numpy(y))
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-3, atol=1e-4)
+
+
+def test_time_distributed_criterion(nprng):
+    x = nprng.randn(2, 3, 4).astype(np.float32)
+    y = nprng.randn(2, 3, 4).astype(np.float32)
+    c = nn.TimeDistributedCriterion(nn.MSECriterion(), size_average=True)
+    ours = c.forward(jnp.asarray(x), jnp.asarray(y))
+    ref = np.mean([F.mse_loss(torch.from_numpy(x[:, t]), torch.from_numpy(y[:, t])).item()
+                   for t in range(3)])
+    np.testing.assert_allclose(float(ours), ref, rtol=1e-3, atol=1e-4)
+
+
+def test_criterion_backward_matches_torch(nprng):
+    x = _logits(nprng)
+    target = np.array([2, 1, 4, 5], dtype=np.float32)
+    ours = nn.CrossEntropyCriterion().backward(jnp.asarray(x), jnp.asarray(target))
+    tx = torch.from_numpy(x).requires_grad_(True)
+    F.cross_entropy(tx, torch.from_numpy(target).long() - 1).backward()
+    np.testing.assert_allclose(np.asarray(ours), tx.grad.numpy(), rtol=1e-3, atol=1e-4)

@@ -1,0 +1,239 @@
+"""Oracle tests against PyTorch (CPU) for the core layer zoo.
+
+Plays the role of the reference's Torch7 oracle suite (torch/ 115 specs,
+torch/TH.scala): identical weights are loaded into both frameworks and
+outputs compared elementwise.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+
+TOL = dict(rtol=1e-4, atol=1e-5)
+
+
+def t2n(t):
+    return t.detach().numpy()
+
+
+@pytest.fixture
+def x2d(nprng):
+    return nprng.randn(4, 7).astype(np.float32)
+
+
+@pytest.fixture
+def x4d(nprng):
+    return nprng.randn(2, 3, 8, 8).astype(np.float32)
+
+
+class TestLinear:
+    def test_forward(self, nprng, x2d):
+        w = nprng.randn(5, 7).astype(np.float32)
+        b = nprng.randn(5).astype(np.float32)
+        m = nn.Linear(7, 5)
+        y, _ = m.apply({"weight": jnp.asarray(w), "bias": jnp.asarray(b)}, jnp.asarray(x2d))
+        ref = F.linear(torch.from_numpy(x2d), torch.from_numpy(w), torch.from_numpy(b))
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), **TOL)
+
+    def test_no_bias(self, nprng, x2d):
+        w = nprng.randn(5, 7).astype(np.float32)
+        m = nn.Linear(7, 5, with_bias=False)
+        y, _ = m.apply({"weight": jnp.asarray(w)}, jnp.asarray(x2d))
+        np.testing.assert_allclose(np.asarray(y), t2n(F.linear(torch.from_numpy(x2d), torch.from_numpy(w))), **TOL)
+
+
+class TestConv:
+    def test_spatial_convolution(self, nprng, x4d):
+        w = nprng.randn(6, 3, 3, 3).astype(np.float32)
+        b = nprng.randn(6).astype(np.float32)
+        m = nn.SpatialConvolution(3, 6, 3, 3, 2, 2, 1, 1)
+        y, _ = m.apply({"weight": jnp.asarray(w), "bias": jnp.asarray(b)}, jnp.asarray(x4d))
+        ref = F.conv2d(torch.from_numpy(x4d), torch.from_numpy(w), torch.from_numpy(b),
+                       stride=(2, 2), padding=(1, 1))
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+
+    def test_grouped(self, nprng):
+        x = nprng.randn(2, 4, 6, 6).astype(np.float32)
+        w = nprng.randn(8, 2, 3, 3).astype(np.float32)
+        b = nprng.randn(8).astype(np.float32)
+        m = nn.SpatialConvolution(4, 8, 3, 3, n_group=2)
+        y, _ = m.apply({"weight": jnp.asarray(w), "bias": jnp.asarray(b)}, jnp.asarray(x))
+        ref = F.conv2d(torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b), groups=2)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+
+    def test_dilated(self, nprng, x4d):
+        w = nprng.randn(5, 3, 3, 3).astype(np.float32)
+        b = np.zeros(5, dtype=np.float32)
+        m = nn.SpatialDilatedConvolution(3, 5, 3, 3, 1, 1, 2, 2, dilation_w=2, dilation_h=2)
+        y, _ = m.apply({"weight": jnp.asarray(w), "bias": jnp.asarray(b)}, jnp.asarray(x4d))
+        ref = F.conv2d(torch.from_numpy(x4d), torch.from_numpy(w), torch.from_numpy(b),
+                       padding=2, dilation=2)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+
+    def test_full_convolution(self, nprng):
+        x = nprng.randn(2, 4, 5, 5).astype(np.float32)
+        w = nprng.randn(4, 6, 3, 3).astype(np.float32)  # (in, out, kh, kw)
+        b = nprng.randn(6).astype(np.float32)
+        m = nn.SpatialFullConvolution(4, 6, 3, 3, 2, 2, 1, 1, adj_w=1, adj_h=1)
+        y, _ = m.apply({"weight": jnp.asarray(w), "bias": jnp.asarray(b)}, jnp.asarray(x))
+        ref = F.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w), torch.from_numpy(b),
+                                 stride=2, padding=1, output_padding=1)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+
+
+class TestPooling:
+    def test_max_pool(self, x4d):
+        m = nn.SpatialMaxPooling(2, 2, 2, 2)
+        y, _ = m.apply({}, jnp.asarray(x4d))
+        ref = F.max_pool2d(torch.from_numpy(x4d), 2, 2)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), **TOL)
+
+    def test_max_pool_pad_stride(self, x4d):
+        m = nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1)
+        y, _ = m.apply({}, jnp.asarray(x4d))
+        ref = F.max_pool2d(torch.from_numpy(x4d), 3, 2, padding=1)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), **TOL)
+
+    def test_max_pool_ceil(self):
+        x = np.random.RandomState(0).randn(1, 1, 7, 7).astype(np.float32)
+        m = nn.SpatialMaxPooling(3, 3, 2, 2).ceil()
+        y, _ = m.apply({}, jnp.asarray(x))
+        ref = F.max_pool2d(torch.from_numpy(x), 3, 2, ceil_mode=True)
+        assert y.shape == tuple(ref.shape)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), **TOL)
+
+    def test_avg_pool(self, x4d):
+        m = nn.SpatialAveragePooling(2, 2, 2, 2)
+        y, _ = m.apply({}, jnp.asarray(x4d))
+        ref = F.avg_pool2d(torch.from_numpy(x4d), 2, 2)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), **TOL)
+
+    def test_avg_pool_pad(self, x4d):
+        m = nn.SpatialAveragePooling(3, 3, 2, 2, 1, 1, count_include_pad=True)
+        y, _ = m.apply({}, jnp.asarray(x4d))
+        ref = F.avg_pool2d(torch.from_numpy(x4d), 3, 2, padding=1, count_include_pad=True)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), **TOL)
+
+
+class TestActivations:
+    @pytest.mark.parametrize("ours,theirs", [
+        (nn.ReLU(), torch.relu),
+        (nn.ReLU6(), F.relu6),
+        (nn.Tanh(), torch.tanh),
+        (nn.Sigmoid(), torch.sigmoid),
+        (nn.LogSigmoid(), F.logsigmoid),
+        (nn.SoftPlus(), F.softplus),
+        (nn.SoftSign(), F.softsign),
+        (nn.ELU(), F.elu),
+        (nn.LeakyReLU(0.02), lambda t: F.leaky_relu(t, 0.02)),
+        (nn.HardTanh(), F.hardtanh),
+        (nn.HardShrink(0.4), lambda t: F.hardshrink(t, 0.4)),
+        (nn.SoftShrink(0.4), lambda t: F.softshrink(t, 0.4)),
+        (nn.TanhShrink(), F.tanhshrink),
+        (nn.Abs(), torch.abs),
+        (nn.Square(), torch.square),
+    ])
+    def test_elementwise(self, nprng, ours, theirs):
+        x = nprng.randn(3, 5).astype(np.float32)
+        y, _ = ours.apply({}, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(y), t2n(theirs(torch.from_numpy(x))), **TOL)
+
+    def test_softmax_logsoftmax(self, x2d):
+        y, _ = nn.SoftMax().apply({}, jnp.asarray(x2d))
+        np.testing.assert_allclose(np.asarray(y), t2n(F.softmax(torch.from_numpy(x2d), dim=-1)), **TOL)
+        y, _ = nn.LogSoftMax().apply({}, jnp.asarray(x2d))
+        np.testing.assert_allclose(np.asarray(y), t2n(F.log_softmax(torch.from_numpy(x2d), dim=-1)), **TOL)
+
+    def test_prelu(self, nprng, x2d):
+        w = np.array([0.1] * 7, dtype=np.float32)
+        m = nn.PReLU(7)
+        y, _ = m.apply({"weight": jnp.asarray(w)}, jnp.asarray(x2d))
+        ref = F.prelu(torch.from_numpy(x2d), torch.from_numpy(w))
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), **TOL)
+
+
+class TestNormalization:
+    def test_batchnorm_train(self, nprng, x2d):
+        m = nn.BatchNormalization(7)
+        w = nprng.rand(7).astype(np.float32)
+        b = nprng.randn(7).astype(np.float32)
+        params = {"weight": jnp.asarray(w), "bias": jnp.asarray(b)}
+        y, bufs = m.apply(params, jnp.asarray(x2d), training=True)
+        tm = torch.nn.BatchNorm1d(7, momentum=0.1)
+        tm.weight.data = torch.from_numpy(w)
+        tm.bias.data = torch.from_numpy(b)
+        tm.train()
+        ref = tm(torch.from_numpy(x2d))
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(bufs["running_mean"]), t2n(tm.running_mean), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(bufs["running_var"]), t2n(tm.running_var), rtol=1e-4, atol=1e-5)
+
+    def test_spatial_batchnorm_eval(self, nprng, x4d):
+        m = nn.SpatialBatchNormalization(3)
+        w = nprng.rand(3).astype(np.float32)
+        b = nprng.randn(3).astype(np.float32)
+        rm = nprng.randn(3).astype(np.float32)
+        rv = nprng.rand(3).astype(np.float32) + 0.5
+        params = {"weight": jnp.asarray(w), "bias": jnp.asarray(b)}
+        bufs = {"running_mean": jnp.asarray(rm), "running_var": jnp.asarray(rv)}
+        y, _ = m.apply(params, jnp.asarray(x4d), buffers=bufs, training=False)
+        tm = torch.nn.BatchNorm2d(3)
+        tm.weight.data = torch.from_numpy(w)
+        tm.bias.data = torch.from_numpy(b)
+        tm.running_mean.data = torch.from_numpy(rm)
+        tm.running_var.data = torch.from_numpy(rv)
+        tm.eval()
+        np.testing.assert_allclose(np.asarray(y), t2n(tm(torch.from_numpy(x4d))), rtol=1e-3, atol=1e-4)
+
+    def test_lrn(self, nprng, x4d):
+        m = nn.SpatialCrossMapLRN(5, 1.0, 0.75, 1.0)
+        y, _ = m.apply({}, jnp.asarray(x4d))
+        ref = torch.nn.LocalResponseNorm(5, alpha=1.0, beta=0.75, k=1.0)(torch.from_numpy(x4d))
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+
+    def test_normalize(self, nprng, x2d):
+        y, _ = nn.Normalize(2.0).apply({}, jnp.asarray(x2d))
+        ref = F.normalize(torch.from_numpy(x2d), p=2.0, dim=-1)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), **TOL)
+
+
+class TestEmbeddingEtc:
+    def test_lookup_table(self, nprng):
+        w = nprng.randn(10, 4).astype(np.float32)
+        idx = np.array([[1, 3, 5], [2, 4, 10]], dtype=np.float32)  # 1-based
+        m = nn.LookupTable(10, 4)
+        y, _ = m.apply({"weight": jnp.asarray(w)}, jnp.asarray(idx))
+        ref = F.embedding(torch.from_numpy(idx).long() - 1, torch.from_numpy(w))
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), **TOL)
+
+    def test_bilinear(self, nprng):
+        x1 = nprng.randn(3, 4).astype(np.float32)
+        x2 = nprng.randn(3, 5).astype(np.float32)
+        w = nprng.randn(2, 4, 5).astype(np.float32)
+        b = nprng.randn(2).astype(np.float32)
+        m = nn.Bilinear(4, 5, 2)
+        y, _ = m.apply({"weight": jnp.asarray(w), "bias": jnp.asarray(b)},
+                       [jnp.asarray(x1), jnp.asarray(x2)])
+        ref = F.bilinear(torch.from_numpy(x1), torch.from_numpy(x2),
+                         torch.from_numpy(w), torch.from_numpy(b))
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+
+    def test_pairwise_distance(self, nprng):
+        x1 = nprng.randn(3, 6).astype(np.float32)
+        x2 = nprng.randn(3, 6).astype(np.float32)
+        m = nn.PairwiseDistance(2)
+        y, _ = m.apply({}, [jnp.asarray(x1), jnp.asarray(x2)])
+        ref = F.pairwise_distance(torch.from_numpy(x1), torch.from_numpy(x2), p=2, eps=0)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+
+    def test_cosine_distance(self, nprng):
+        x1 = nprng.randn(3, 6).astype(np.float32)
+        x2 = nprng.randn(3, 6).astype(np.float32)
+        m = nn.CosineDistance()
+        y, _ = m.apply({}, [jnp.asarray(x1), jnp.asarray(x2)])
+        ref = F.cosine_similarity(torch.from_numpy(x1), torch.from_numpy(x2), dim=-1)
+        np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)

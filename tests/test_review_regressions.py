@@ -1,0 +1,91 @@
+"""Regression tests for review findings (stale vjp cache, simplex build,
+Reshape batch-of-1, PReLU CHW, module save/load, LSTM gate dropout)."""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+
+
+def test_backward_uses_fresh_rng_each_call():
+    d = nn.Dropout(0.5).build(seed=0)
+    d.training()
+    x = jnp.ones((8, 32))
+    g = jnp.ones((8, 32))
+    grads = [np.asarray(d.backward(x, g)) for _ in range(3)]
+    assert not (np.array_equal(grads[0], grads[1]) and np.array_equal(grads[1], grads[2]))
+
+
+def test_backward_sees_current_buffers():
+    bn = nn.BatchNormalization(4).build(seed=0)
+    bn.evaluate()
+    x = jnp.asarray(np.random.RandomState(0).randn(6, 4).astype(np.float32))
+    g1 = np.asarray(bn.backward(x, jnp.ones((6, 4))))
+    # change running stats; eval-mode backward must reflect them
+    bn.buffers = {"running_mean": jnp.full((4,), 5.0), "running_var": jnp.full((4,), 9.0)}
+    g2 = np.asarray(bn.backward(x, jnp.ones((6, 4))))
+    assert not np.allclose(g1, g2)
+
+
+def test_class_simplex_geometry():
+    for n in (2, 3, 5):
+        s = np.asarray(nn.ClassSimplexCriterion(n).simplex, dtype=np.float64)
+        norms = np.linalg.norm(s, axis=1)
+        np.testing.assert_allclose(norms, 1.0, atol=1e-5)
+        for i in range(n):
+            for j in range(i + 1, n):
+                np.testing.assert_allclose(s[i] @ s[j], -1.0 / n, atol=1e-5)
+
+
+def test_reshape_keeps_singleton_batch():
+    y, _ = nn.Reshape((2, 2)).apply({}, jnp.ones((1, 4)))
+    assert y.shape == (1, 2, 2)
+    y, _ = nn.Reshape((2, 2)).apply({}, jnp.ones((3, 4)))
+    assert y.shape == (3, 2, 2)
+    y, _ = nn.Reshape((2, 2), batch_mode=False).apply({}, jnp.ones((1, 4)))
+    assert y.shape == (2, 2)
+    y, _ = nn.View(2, 2).apply({}, jnp.ones((1, 4)))
+    assert y.shape == (1, 2, 2)
+
+
+def test_prelu_chw_unbatched():
+    w = jnp.asarray([0.1, 0.2, 0.3, 0.4])
+    m = nn.PReLU(4)
+    x = -jnp.ones((4, 5, 6))
+    y, _ = m.apply({"weight": w}, x)
+    np.testing.assert_allclose(np.asarray(y[2]), -0.3, rtol=1e-6)
+    # batched NCHW still axis 1
+    xb = -jnp.ones((2, 4, 5, 6))
+    y, _ = m.apply({"weight": w}, xb)
+    np.testing.assert_allclose(np.asarray(y[0, 3]), -0.4, rtol=1e-6)
+
+
+def test_module_save_load_roundtrip(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2)).build(seed=3)
+    x = jnp.ones((2, 4))
+    y1 = np.asarray(m.forward(x))
+    path = str(tmp_path / "model.bin")
+    m.save(path)
+    m2 = nn.Module.load(path)
+    y2 = np.asarray(m2.forward(x))
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)
+    with pytest.raises(FileExistsError):
+        m.save(path)
+    m.save(path, overwrite=True)
+
+
+def test_lstm_gate_dropout_active():
+    cell = nn.LSTM(8, 8, p=0.9)
+    m = nn.Recurrent(cell)
+    params = m.init(jax.random.PRNGKey(0))
+    x = jnp.ones((2, 5, 8))
+    y_eval, _ = m.apply(params, x, training=False)
+    y_train, _ = m.apply(params, x, training=True, rng=jax.random.PRNGKey(1))
+    assert not np.allclose(np.asarray(y_eval), np.asarray(y_train))
+    # two different keys -> different outputs
+    y_train2, _ = m.apply(params, x, training=True, rng=jax.random.PRNGKey(2))
+    assert not np.allclose(np.asarray(y_train), np.asarray(y_train2))
