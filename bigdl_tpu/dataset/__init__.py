@@ -1,0 +1,16 @@
+"""dataset: composable input pipeline (ref spark/dl/.../dataset/, 3,715 LoC).
+
+``DataSet`` sources + ``Transformer`` stages chained with ``>>`` (the
+reference's ``->``), producing ``MiniBatch``es for the optimizers.  The
+RDD substrate is replaced by per-host sharded file sets + a threaded
+host-side prefetcher feeding the TPU.
+"""
+from bigdl_tpu.dataset.types import Sample, MiniBatch, ByteRecord, LabeledImage, LabeledSentence
+from bigdl_tpu.dataset.dataset import (
+    DataSet, AbstractDataSet, LocalDataSet, DistributedDataSet, LocalArrayDataSet,
+)
+from bigdl_tpu.dataset.transformer import (
+    Transformer, ChainedTransformer, SampleToBatch, Prefetcher,
+)
+from bigdl_tpu.dataset import image, text
+from bigdl_tpu.dataset import mnist, cifar

@@ -1,0 +1,52 @@
+"""CIFAR-10 reader (ref models/vgg/Train.scala load path + pyspark
+bigdl/dataset).  Reads the standard python/binary pickle batches from disk;
+``synthetic`` generates learnable fake data when no data dir exists."""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.types import LabeledImage
+
+# per-channel BGR train stats (float pixel scale 0..255)
+TRAIN_MEAN = (113.86538318359375, 122.950394140625, 125.306918046875)
+TRAIN_STD = (66.70489964063091, 62.08870764001421, 62.993219278136884)
+
+
+def _records_from_arrays(data: np.ndarray, labels, count: Optional[int] = None):
+    out = []
+    n = len(labels) if count is None else min(count, len(labels))
+    for i in range(n):
+        chw_rgb = data[i].reshape(3, 32, 32).astype(np.float32)
+        chw_bgr = chw_rgb[::-1]  # reference images are BGR
+        out.append(LabeledImage(np.ascontiguousarray(chw_bgr), float(labels[i]) + 1.0))
+    return out
+
+
+def load(folder: str, train: bool = True) -> list[LabeledImage]:
+    """Load from the 'cifar-10-batches-py' layout under ``folder``."""
+    d = folder
+    if os.path.isdir(os.path.join(folder, "cifar-10-batches-py")):
+        d = os.path.join(folder, "cifar-10-batches-py")
+    names = [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+    records = []
+    for name in names:
+        path = os.path.join(d, name)
+        with open(path, "rb") as f:
+            batch = pickle.load(f, encoding="bytes")
+        records.extend(_records_from_arrays(batch[b"data"], batch[b"labels"]))
+    return records
+
+
+def synthetic(n: int = 1024, seed: int = 0) -> list[LabeledImage]:
+    rng = np.random.RandomState(seed)
+    records = []
+    for i in range(n):
+        label = i % 10
+        img = rng.randint(0, 60, size=(3, 32, 32)).astype(np.float32)
+        img[label % 3, (label // 3) * 8:(label // 3) * 8 + 8, :] += 150
+        records.append(LabeledImage(img, float(label) + 1.0))
+    return records

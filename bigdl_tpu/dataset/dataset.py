@@ -1,0 +1,149 @@
+"""DataSet sources (ref dataset/DataSet.scala:46-433).
+
+``LocalArrayDataSet`` = in-memory records with epoch reshuffle (ref
+LocalDataSet :110); ``DistributedDataSet`` = the per-host shard of a global
+dataset, indexed by JAX process (the role the RDD partition played; the
+reference's CachedDistriDataSet serves infinite shuffled iterators via
+index permutation, DataSet.scala:202-262 — same design here).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.utils.rng import RandomGenerator
+
+
+class AbstractDataSet:
+    """data(train) / shuffle / size / transform (ref DataSet.scala:46-84)."""
+
+    def data(self, train: bool) -> Iterator:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self) -> None:
+        pass
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self, transformer)
+
+    def __rshift__(self, transformer: Transformer) -> "TransformedDataSet":
+        return self.transform(transformer)
+
+
+class TransformedDataSet(AbstractDataSet):
+    def __init__(self, source: AbstractDataSet, transformer: Transformer):
+        self.source = source
+        self.transformer = transformer
+
+    def data(self, train: bool) -> Iterator:
+        return self.transformer(self.source.data(train))
+
+    def size(self) -> int:
+        return self.source.size()
+
+    def shuffle(self) -> None:
+        self.source.shuffle()
+
+
+class LocalDataSet(AbstractDataSet):
+    """Marker base for single-host datasets (ref DataSet.scala:110)."""
+
+
+class LocalArrayDataSet(LocalDataSet):
+    """In-memory records; train iteration is infinite over a permuted index
+    (one permutation per shuffle/epoch), eval iteration is one pass."""
+
+    def __init__(self, records: Sequence, seed: int = 1):
+        self.records = list(records)
+        self._rng = RandomGenerator(seed)
+        self._perm = np.arange(len(self.records))
+
+    def size(self) -> int:
+        return len(self.records)
+
+    def shuffle(self) -> None:
+        n = len(self.records)
+        self._perm = self._rng.randperm(n) - 1  # randperm is 1-based
+
+    def data(self, train: bool) -> Iterator:
+        if train:
+            def infinite():
+                while True:
+                    for i in self._perm:
+                        yield self.records[int(i)]
+            return infinite()
+        return iter(self.records)
+
+
+class DistributedDataSet(AbstractDataSet):
+    """The per-host shard of a global dataset (ref DistributedDataSet
+    :163 + CachedDistriDataSet :202-262).  ``partition_by`` splits the
+    global record list round-robin across JAX processes so every host
+    holds ~1/P of the data — the RDD-partition-to-host affinity of
+    ZippedPartitionsWithLocalityRDD is implicit: each host only ever
+    touches its own shard."""
+
+    def __init__(self, records: Sequence, process_index: Optional[int] = None,
+                 process_count: Optional[int] = None, seed: int = 1):
+        import jax
+        pi = jax.process_index() if process_index is None else process_index
+        pc = jax.process_count() if process_count is None else process_count
+        self.global_size = len(records)
+        self.local = LocalArrayDataSet(list(records)[pi::pc], seed=seed + pi)
+        self.process_index = pi
+        self.process_count = pc
+
+    def size(self) -> int:
+        return self.global_size
+
+    def local_size(self) -> int:
+        return self.local.size()
+
+    def shuffle(self) -> None:
+        self.local.shuffle()
+
+    def data(self, train: bool) -> Iterator:
+        return self.local.data(train)
+
+
+class DataSet:
+    """Factories (ref DataSet.scala object: array/rdd/ImageFolder/
+    SeqFileFolder)."""
+
+    @staticmethod
+    def array(records: Sequence, distributed: bool = False, seed: int = 1) -> AbstractDataSet:
+        if distributed:
+            return DistributedDataSet(records, seed=seed)
+        return LocalArrayDataSet(records, seed=seed)
+
+    @staticmethod
+    def image_folder(path: str, distributed: bool = False) -> AbstractDataSet:
+        """Scan <path>/<label-dir>/<img files>; labels are 1-based by sorted
+        dir name (ref DataSet.ImageFolder.paths :318-378).  Returns records
+        of (filepath, label)."""
+        classes = sorted(d for d in os.listdir(path)
+                         if os.path.isdir(os.path.join(path, d)))
+        records = []
+        for li, cls in enumerate(classes, start=1):
+            d = os.path.join(path, cls)
+            for fname in sorted(os.listdir(d)):
+                records.append((os.path.join(d, fname), float(li)))
+        return DataSet.array(records, distributed=distributed)
+
+    @staticmethod
+    def record_files(paths: Sequence[str], distributed: bool = False) -> AbstractDataSet:
+        """Dataset over packed record shard files (the SequenceFile
+        equivalent, see bigdl_tpu.dataset.seqfile); records are the raw
+        (bytes, label) pairs."""
+        from bigdl_tpu.dataset.seqfile import read_shard
+        files = list(paths)
+        all_records = []
+        for f in files:
+            all_records.extend(read_shard(f))
+        return DataSet.array(all_records, distributed=distributed)

@@ -1,0 +1,263 @@
+"""Image transformers (ref dataset/image/, one Scala class each: decode,
+augment, normalize, batch).  Images are CHW float32 numpy on host; the
+decoded channel order is BGR to match the reference (BGRImage).
+
+Decoding uses PIL if available, else raw numpy paths; the heavy per-image
+work runs on the host CPU pool (Prefetcher), never on the TPU.
+"""
+from __future__ import annotations
+
+import io
+from typing import Iterator, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.transformer import SampleToBatch, Transformer
+from bigdl_tpu.dataset.types import ByteRecord, LabeledImage, MiniBatch, Sample
+from bigdl_tpu.utils.rng import RandomGenerator
+
+
+def _decode_image(data: bytes) -> np.ndarray:
+    """bytes -> HWC uint8 RGB."""
+    try:
+        from PIL import Image
+        img = Image.open(io.BytesIO(data)).convert("RGB")
+        return np.asarray(img, dtype=np.uint8)
+    except ImportError as e:  # pragma: no cover - PIL is present in CI image
+        raise RuntimeError("image decoding requires PIL") from e
+
+
+class BytesToGreyImg(Transformer):
+    """Raw bytes (row-major grey, e.g. MNIST) -> LabeledImage (1,H,W)
+    (ref dataset/image/BytesToGreyImg.scala)."""
+
+    def __init__(self, row: int, col: int):
+        self.row = row
+        self.col = col
+
+    def transform_one(self, r: ByteRecord) -> LabeledImage:
+        arr = np.frombuffer(r.data, dtype=np.uint8).reshape(self.row, self.col)
+        return LabeledImage(arr[None].astype(np.float32), r.label)
+
+
+class BytesToBGRImg(Transformer):
+    """Encoded image bytes -> LabeledImage (3,H,W) BGR float [0,255]
+    (ref dataset/image/BytesToBGRImg.scala)."""
+
+    def transform_one(self, r: ByteRecord) -> LabeledImage:
+        rgb = _decode_image(r.data).astype(np.float32)
+        bgr = rgb[:, :, ::-1]
+        return LabeledImage(np.ascontiguousarray(bgr.transpose(2, 0, 1)), r.label)
+
+
+class LocalImgReader(Transformer):
+    """(path, label) -> LabeledImage, with optional resize of the shorter
+    side to ``scale_to`` (ref dataset/image/LocalImgReader.scala:26)."""
+
+    def __init__(self, scale_to: int = -1):
+        self.scale_to = scale_to
+
+    def transform_one(self, rec) -> LabeledImage:
+        path, label = rec
+        with open(path, "rb") as f:
+            rgb = _decode_image(f.read())
+        if self.scale_to > 0:
+            from PIL import Image
+            h, w = rgb.shape[:2]
+            if h < w:
+                nh, nw = self.scale_to, int(w * self.scale_to / h)
+            else:
+                nh, nw = int(h * self.scale_to / w), self.scale_to
+            rgb = np.asarray(Image.fromarray(rgb).resize((nw, nh)), dtype=np.uint8)
+        bgr = rgb[:, :, ::-1].astype(np.float32)
+        return LabeledImage(np.ascontiguousarray(bgr.transpose(2, 0, 1)), float(label))
+
+
+class GreyImgNormalizer(Transformer):
+    """(x - mean) / std (ref dataset/image/GreyImgNormalizer.scala).
+    Construct with explicit stats, or ``fit`` over a dataset."""
+
+    def __init__(self, mean: float, std: float):
+        self.mean = mean
+        self.std = std
+
+    @staticmethod
+    def fit(dataset, max_samples: int = 10000) -> "GreyImgNormalizer":
+        total, sq, n = 0.0, 0.0, 0
+        for i, img in enumerate(dataset.data(train=False)):
+            if i >= max_samples:
+                break
+            total += float(img.data.sum())
+            sq += float((img.data ** 2).sum())
+            n += img.data.size
+        mean = total / n
+        std = float(np.sqrt(sq / n - mean * mean))
+        return GreyImgNormalizer(mean, std)
+
+    def transform_one(self, img: LabeledImage) -> LabeledImage:
+        return LabeledImage((img.data - self.mean) / self.std, img.label)
+
+
+class BGRImgNormalizer(Transformer):
+    """Per-channel (x - mean)/std, channels in BGR order
+    (ref dataset/image/BGRImgNormalizer.scala)."""
+
+    def __init__(self, mean: tuple[float, float, float], std: tuple[float, float, float]):
+        self.mean = np.asarray(mean, dtype=np.float32).reshape(3, 1, 1)
+        self.std = np.asarray(std, dtype=np.float32).reshape(3, 1, 1)
+
+    def transform_one(self, img: LabeledImage) -> LabeledImage:
+        return LabeledImage((img.data - self.mean) / self.std, img.label)
+
+
+class BGRImgPixelNormalizer(Transformer):
+    """Subtract a full per-pixel mean image (ref
+    dataset/image/BGRImgPixelNormalizer.scala)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, dtype=np.float32)
+
+    def transform_one(self, img: LabeledImage) -> LabeledImage:
+        return LabeledImage(img.data - self.means, img.label)
+
+
+class HFlip(Transformer):
+    """Random horizontal flip with probability ``threshold``
+    (ref dataset/image/HFlip.scala)."""
+
+    def __init__(self, threshold: float = 0.5, seed: int = 0):
+        self.threshold = threshold
+        self._rng = RandomGenerator(seed)
+
+    def transform_one(self, img: LabeledImage) -> LabeledImage:
+        if self._rng.random() < self.threshold:
+            return LabeledImage(np.ascontiguousarray(img.data[:, :, ::-1]), img.label)
+        return img
+
+
+class _Cropper(Transformer):
+    def __init__(self, crop_w: int, crop_h: int, random: bool, seed: int = 0):
+        self.crop_w = crop_w
+        self.crop_h = crop_h
+        self.random = random
+        self._rng = RandomGenerator(seed)
+
+    def transform_one(self, img: LabeledImage) -> LabeledImage:
+        _, h, w = img.data.shape
+        if self.random:
+            y0 = int(self._rng.random() * (h - self.crop_h + 1))
+            x0 = int(self._rng.random() * (w - self.crop_w + 1))
+        else:
+            y0 = (h - self.crop_h) // 2
+            x0 = (w - self.crop_w) // 2
+        patch = img.data[:, y0:y0 + self.crop_h, x0:x0 + self.crop_w]
+        return LabeledImage(np.ascontiguousarray(patch), img.label)
+
+
+class BGRImgCropper(_Cropper):
+    """Center crop (ref dataset/image/BGRImgCropper.scala)."""
+
+    def __init__(self, crop_w: int, crop_h: int):
+        super().__init__(crop_w, crop_h, random=False)
+
+
+class BGRImgRdmCropper(_Cropper):
+    """Random crop (ref dataset/image/BGRImgRdmCropper.scala)."""
+
+    def __init__(self, crop_w: int, crop_h: int, seed: int = 0):
+        super().__init__(crop_w, crop_h, random=True, seed=seed)
+
+
+class GreyImgCropper(_Cropper):
+    def __init__(self, crop_w: int, crop_h: int):
+        super().__init__(crop_w, crop_h, random=False)
+
+
+class ColorJitter(Transformer):
+    """Random brightness/contrast/saturation in [1-d, 1+d]
+    (ref dataset/image/ColoJitter.scala)."""
+
+    def __init__(self, delta: float = 0.4, seed: int = 0):
+        self.delta = delta
+        self._rng = RandomGenerator(seed)
+
+    def transform_one(self, img: LabeledImage) -> LabeledImage:
+        x = img.data
+        order = self._rng.randperm(3)
+        for op in order:
+            a = 1.0 + self._rng.uniform(-self.delta, self.delta)
+            if op == 1:  # brightness
+                x = x * a
+            elif op == 2:  # contrast
+                x = (x - x.mean()) * a + x.mean()
+            else:  # saturation: blend with per-pixel grey
+                grey = x.mean(axis=0, keepdims=True)
+                x = x * a + grey * (1 - a)
+        return LabeledImage(x.astype(np.float32), img.label)
+
+
+class Lighting(Transformer):
+    """AlexNet-style PCA lighting noise (ref dataset/image/Lighting.scala:
+    34-36; constants and the uniform(0, std) alpha draw match the
+    reference, which operates on BGR images)."""
+
+    _eigval = np.asarray([0.2175, 0.0188, 0.0045], dtype=np.float32)
+    _eigvec = np.asarray([
+        [-0.5675, 0.7192, 0.4009],
+        [-0.5808, -0.0045, -0.8140],
+        [-0.5836, -0.6948, 0.4203],
+    ], dtype=np.float32)
+
+    def __init__(self, alpha_std: float = 0.1, seed: int = 0):
+        self.alpha_std = alpha_std
+        self._rng = RandomGenerator(seed)
+
+    def transform_one(self, img: LabeledImage) -> LabeledImage:
+        alpha = np.asarray([self._rng.uniform(0, self.alpha_std) for _ in range(3)],
+                           dtype=np.float32)
+        delta = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return LabeledImage(img.data + delta.reshape(3, 1, 1), img.label)
+
+
+class _ImgToSample(Transformer):
+    def transform_one(self, img: LabeledImage) -> Sample:
+        return Sample(img.data, np.asarray(img.label, dtype=np.float32))
+
+
+class GreyImgToSample(_ImgToSample):
+    pass
+
+
+class BGRImgToSample(_ImgToSample):
+    pass
+
+
+class GreyImgToBatch(Transformer):
+    """LabeledImage stream -> MiniBatch stream
+    (ref dataset/image/GreyImgToBatch.scala)."""
+
+    def __init__(self, batch_size: int):
+        self._chain = _ImgToSample() >> SampleToBatch(batch_size)
+
+    def __call__(self, it: Iterator) -> Iterator[MiniBatch]:
+        return self._chain(it)
+
+
+class BGRImgToBatch(GreyImgToBatch):
+    pass
+
+
+class MTLabeledBGRImgToBatch(Transformer):
+    """Threaded decode+batch: the reference spreads per-image transform
+    work over Engine.coreNumber() threads with per-thread transformer
+    clones (dataset/image/MTLabeledBGRImgToBatch.scala:52-80); here a
+    bounded prefetcher overlaps the same work with device steps."""
+
+    def __init__(self, width: int, height: int, batch_size: int,
+                 transformer: Transformer, depth: int = 8):
+        from bigdl_tpu.dataset.transformer import Prefetcher
+        self._chain = transformer >> _ImgToSample() >> \
+            SampleToBatch(batch_size) >> Prefetcher(depth)
+
+    def __call__(self, it: Iterator) -> Iterator[MiniBatch]:
+        return self._chain(it)

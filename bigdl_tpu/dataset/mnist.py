@@ -1,0 +1,71 @@
+"""MNIST reader (ref pyspark bigdl/dataset/mnist.py + the Scala
+models/lenet/Train.scala load path).  Reads the standard IDX files from
+disk — this environment has no egress, so ``load`` never downloads; use
+``synthetic`` for tests/benchmarks when no data dir is present."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.types import ByteRecord
+
+TRAIN_MEAN = 0.13066047740239506 * 255
+TRAIN_STD = 0.3081078 * 255
+TEST_MEAN = 0.13251460696903547 * 255
+TEST_STD = 0.31048024 * 255
+
+
+def _open(path):
+    return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+
+def read_images(path: str) -> np.ndarray:
+    with _open(path) as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad magic {magic}"
+        return np.frombuffer(f.read(n * rows * cols), dtype=np.uint8).reshape(n, rows, cols)
+
+
+def read_labels(path: str) -> np.ndarray:
+    with _open(path) as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad magic {magic}"
+        return np.frombuffer(f.read(n), dtype=np.uint8)
+
+
+def load(folder: str, train: bool = True) -> list[ByteRecord]:
+    """Load (bytes, 1-based label) records from IDX files in ``folder``."""
+    prefix = "train" if train else "t10k"
+    candidates = [f"{prefix}-images-idx3-ubyte", f"{prefix}-images.idx3-ubyte"]
+    img_path = lbl_path = None
+    for c in candidates:
+        for suffix in ("", ".gz"):
+            p = os.path.join(folder, c + suffix)
+            if os.path.exists(p):
+                img_path = p
+                lbl_path = p.replace("images-idx3", "labels-idx1").replace(
+                    "images.idx3", "labels.idx1")
+    if img_path is None or not os.path.exists(lbl_path):
+        raise FileNotFoundError(f"MNIST IDX files not found under {folder}")
+    images = read_images(img_path)
+    labels = read_labels(lbl_path)
+    return [ByteRecord(images[i].tobytes(), float(labels[i]) + 1.0)
+            for i in range(len(labels))]
+
+
+def synthetic(n: int = 1024, seed: int = 0) -> list[ByteRecord]:
+    """Deterministic fake MNIST-shaped records (class-dependent blobs so a
+    model can actually learn from them)."""
+    rng = np.random.RandomState(seed)
+    records = []
+    for i in range(n):
+        label = i % 10
+        img = rng.randint(0, 50, size=(28, 28)).astype(np.uint8)
+        r, c = divmod(label, 4)
+        img[r * 8:r * 8 + 8, c * 7:c * 7 + 7] += 180
+        records.append(ByteRecord(img.tobytes(), float(label) + 1.0))
+    return records

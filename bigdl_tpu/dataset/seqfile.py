@@ -1,0 +1,64 @@
+"""Packed record shards: the Hadoop-SequenceFile equivalent
+(ref dataset/DataSet.scala SeqFileFolder :380-433 and the writer
+dataset/image/BGRImgToLocalSeqFile.scala; generator CLI analog in
+bigdl_tpu.models.utils).
+
+Format (little-endian), one record:
+    u32 payload_len | u32 crc32(payload) | f32 label | payload bytes
+
+Shards are independent files so per-host sharding = file-list splitting.
+A C-accelerated reader can mmap these; the format is deliberately trivial.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterable, Iterator, Sequence
+
+from bigdl_tpu.dataset.types import ByteRecord
+
+_HEADER = struct.Struct("<IIf")
+MAGIC = b"BTRS\x01"  # bigdl-tpu record shard v1
+
+
+def write_shard(path: str, records: Iterable[ByteRecord]) -> int:
+    """Write records to one shard file; returns the record count."""
+    n = 0
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC)
+        for r in records:
+            payload = r.data
+            f.write(_HEADER.pack(len(payload), zlib.crc32(payload) & 0xFFFFFFFF,
+                                 float(r.label)))
+            f.write(payload)
+            n += 1
+    os.replace(tmp, path)
+    return n
+
+
+def read_shard(path: str) -> Iterator[ByteRecord]:
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ValueError(f"{path}: not a record shard (bad magic {magic!r})")
+        while True:
+            head = f.read(_HEADER.size)
+            if not head:
+                return
+            length, crc, label = _HEADER.unpack(head)
+            payload = f.read(length)
+            if len(payload) != length:
+                raise ValueError(f"{path}: truncated record")
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+                raise ValueError(f"{path}: crc mismatch")
+            yield ByteRecord(payload, label)
+
+
+def write_sharded(prefix: str, records: Sequence[ByteRecord], n_shards: int) -> list[str]:
+    """Split records round-robin into n_shards files <prefix>-NNNNN."""
+    paths = [f"{prefix}-{i:05d}" for i in range(n_shards)]
+    for i, p in enumerate(paths):
+        write_shard(p, records[i::n_shards])
+    return paths

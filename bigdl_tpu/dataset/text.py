@@ -1,0 +1,142 @@
+"""Text transformers (ref dataset/text/: SentenceSplitter,
+SentenceTokenizer, SentenceBiPadding, Dictionary, TextToLabeledSentence,
+LabeledSentenceToSample).
+
+The reference uses Apache OpenNLP for splitting/tokenizing; here simple
+regex equivalents (the pipeline contract — a stream of token lists feeding
+a Dictionary then id sequences — is what matters for parity).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from collections import Counter
+from typing import Iterable, Iterator, Optional
+
+import numpy as np
+
+from bigdl_tpu.dataset.transformer import Transformer
+from bigdl_tpu.dataset.types import LabeledSentence, Sample
+
+SENTENCE_START = "SENTENCESTART"
+SENTENCE_END = "SENTENCEEND"
+
+
+class SentenceSplitter(Transformer):
+    """Document string -> sentence strings (ref text/SentenceSplitter.scala)."""
+
+    _pat = re.compile(r"(?<=[.!?])\s+")
+
+    def __call__(self, it: Iterator[str]) -> Iterator[str]:
+        for doc in it:
+            for s in self._pat.split(doc.strip()):
+                if s:
+                    yield s
+
+
+class SentenceTokenizer(Transformer):
+    """Sentence string -> token list (ref text/SentenceTokenizer.scala)."""
+
+    _pat = re.compile(r"[A-Za-z0-9']+|[^\sA-Za-z0-9]")
+
+    def transform_one(self, sentence: str) -> list[str]:
+        return self._pat.findall(sentence.lower())
+
+
+class SentenceBiPadding(Transformer):
+    """Add start/end markers (ref text/SentenceBiPadding.scala)."""
+
+    def transform_one(self, tokens: list[str]) -> list[str]:
+        return [SENTENCE_START] + list(tokens) + [SENTENCE_END]
+
+
+class Dictionary:
+    """Vocabulary built from token streams (ref text/Dictionary.scala:33-207):
+    keeps the ``vocab_size`` most frequent words, everything else maps to an
+    unknown id.  Word ids are 0-based here with 1-based lookup done by
+    LookupTable (add 1 when forming samples)."""
+
+    UNK = "<unk>"
+
+    def __init__(self, tokens_stream: Optional[Iterable[list[str]]] = None,
+                 vocab_size: int = 10000):
+        self.word2index: dict[str, int] = {}
+        self.index2word: dict[int, str] = {}
+        self._unk_index = 0
+        if tokens_stream is not None:
+            counts = Counter()
+            for tokens in tokens_stream:
+                counts.update(tokens)
+            kept = [w for w, _ in counts.most_common(vocab_size)]
+            for i, w in enumerate(kept):
+                self.word2index[w] = i
+                self.index2word[i] = w
+            self._unk_index = len(kept)
+
+    def vocab_size(self) -> int:
+        return len(self.word2index) + 1  # + unknown
+
+    def get_index(self, word: str) -> int:
+        return self.word2index.get(word, self._unk_index)
+
+    def get_word(self, index: int) -> str:
+        return self.index2word.get(index, self.UNK)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"word2index": self.word2index}, f)
+
+    @staticmethod
+    def load(path: str) -> "Dictionary":
+        d = Dictionary()
+        with open(path) as f:
+            d.word2index = json.load(f)["word2index"]
+        d.index2word = {i: w for w, i in d.word2index.items()}
+        d._unk_index = len(d.word2index)
+        return d
+
+
+class TextToLabeledSentence(Transformer):
+    """Token list -> LabeledSentence for next-token language modelling:
+    data = ids[:-1], label = ids[1:] (ref text/TextToLabeledSentence.scala)."""
+
+    def __init__(self, dictionary: Dictionary):
+        self.dictionary = dictionary
+
+    def transform_one(self, tokens: list[str]) -> LabeledSentence:
+        ids = np.asarray([self.dictionary.get_index(t) for t in tokens], dtype=np.float32)
+        return LabeledSentence(ids[:-1], ids[1:])
+
+
+class LabeledSentenceToSample(Transformer):
+    """LabeledSentence -> Sample, one-hot features and 1-based labels
+    (ref text/LabeledSentenceToSample.scala).  Pads/truncates to
+    ``fixed_length`` when given (static shapes for XLA)."""
+
+    def __init__(self, vocab_size: int, fixed_length: Optional[int] = None,
+                 one_hot: bool = True, pad_label: float = 1.0):
+        self.vocab_size = vocab_size
+        self.fixed_length = fixed_length
+        self.one_hot = one_hot
+        # pad_label must be a VALID 1-based class: ClassNLLCriterion maps
+        # label-1 to an index, so 0 would silently wrap to the last class.
+        # LM pipelines should pass the SENTENCE_END id + 1.
+        if not (1 <= pad_label <= vocab_size):
+            raise ValueError(f"pad_label {pad_label} outside [1, {vocab_size}]")
+        self.pad_label = pad_label
+
+    def transform_one(self, s: LabeledSentence) -> Sample:
+        n = len(s.data)
+        length = self.fixed_length if self.fixed_length is not None else n
+        ids = np.zeros(length, dtype=np.int64)
+        ids[:min(n, length)] = s.data[:length].astype(np.int64)
+        labels = np.full(length, self.pad_label, dtype=np.float32)
+        m = min(len(s.label), length)
+        labels[:m] = s.label[:m] + 1.0  # 1-based class targets
+        if self.one_hot:
+            feat = np.zeros((length, self.vocab_size), dtype=np.float32)
+            feat[np.arange(length), ids] = 1.0
+        else:
+            feat = (ids + 1).astype(np.float32)  # 1-based for LookupTable
+        return Sample(feat, labels)

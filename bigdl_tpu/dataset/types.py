@@ -1,0 +1,72 @@
+"""Pipeline record types (ref dataset/Types.scala, dataset/Sample.scala,
+dataset/image/Types.scala, dataset/text/Types.scala)."""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+@dataclass
+class Sample:
+    """One training example: (feature, label) host arrays
+    (ref dataset/Sample.scala:32)."""
+    feature: np.ndarray
+    label: np.ndarray
+
+    @staticmethod
+    def from_ndarray(feature, label) -> "Sample":
+        return Sample(np.asarray(feature, dtype=np.float32),
+                      np.asarray(label, dtype=np.float32))
+
+
+@dataclass
+class MiniBatch:
+    """A batch of stacked features/labels (ref dataset/Types.scala:73).
+    Arrays are host numpy; the optimizer moves them on-device (and shards
+    them over the mesh in the distributed path)."""
+    data: np.ndarray
+    labels: np.ndarray
+
+    def size(self) -> int:
+        return self.data.shape[0]
+
+
+@dataclass
+class ByteRecord:
+    """Raw bytes + label (ref dataset/Types.scala:80)."""
+    data: bytes
+    label: float
+
+
+@dataclass
+class LabeledImage:
+    """Decoded image in CHW float32 + 1-based label (ref
+    dataset/image/Types.scala LabeledBGRImage/LabeledGreyImage — both map
+    here; ``channels`` distinguishes grey=1 from BGR=3)."""
+    data: np.ndarray  # (C, H, W) float32
+    label: float
+
+    @property
+    def width(self) -> int:
+        return self.data.shape[2]
+
+    @property
+    def height(self) -> int:
+        return self.data.shape[1]
+
+    @property
+    def channels(self) -> int:
+        return self.data.shape[0]
+
+
+@dataclass
+class LabeledSentence:
+    """Token-id sequence + per-step or scalar label
+    (ref dataset/text/Types.scala:32)."""
+    data: np.ndarray
+    label: np.ndarray
+
+    def data_length(self) -> int:
+        return len(self.data)

@@ -1,0 +1,14 @@
+"""optim: optimization engine (ref spark/dl/.../optim/, 2,475 LoC)."""
+from bigdl_tpu.optim.optim_method import (
+    OptimMethod, SGD, Adagrad, LBFGS, LearningRateSchedule, Default, Poly,
+    Step, EpochStep, EpochDecay, EpochSchedule, Regime, ls_wolfe,
+)
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import (
+    ValidationMethod, ValidationResult, AccuracyResult, LossResult,
+    Top1Accuracy, Top5Accuracy, Loss,
+)
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optimizer import (
+    Optimizer, LocalOptimizer, Validator, LocalValidator,
+)

@@ -1,0 +1,44 @@
+"""Named metric counters (ref optim/Metrics.scala:24-112).
+
+The reference distinguishes local AtomicDouble counters from Spark
+accumulators aggregated on the driver; here a metric is local to the
+process, and in a multi-host job each host reports its own (cross-host
+aggregation of *training* statistics rides the same collectives as
+gradients, so there is no separate accumulator RPC to build).
+"""
+from __future__ import annotations
+
+import threading
+
+
+class Metrics:
+    def __init__(self):
+        self._values: dict[str, float] = {}
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def set(self, name: str, value: float, parallel: int = 1) -> None:
+        with self._lock:
+            self._values[name] = float(value)
+            self._counts[name] = parallel
+
+    def add(self, name: str, value: float) -> None:
+        with self._lock:
+            self._values[name] = self._values.get(name, 0.0) + float(value)
+            self._counts.setdefault(name, 1)
+
+    def get(self, name: str) -> tuple[float, int]:
+        with self._lock:
+            return self._values.get(name, 0.0), self._counts.get(name, 1)
+
+    def summary(self, unit_scale: float = 1.0) -> str:
+        """Summary in seconds.  Values here are recorded in seconds already
+        (the reference stores nanoseconds and divides by 1e9,
+        optim/Metrics.scala:96); pass unit_scale for other units."""
+        with self._lock:
+            lines = ["========== Metrics Summary =========="]
+            for name, v in self._values.items():
+                n = self._counts.get(name, 1)
+                lines.append(f"{name} : {v / unit_scale / max(n, 1)} s")
+            lines.append("=====================================")
+            return "\n".join(lines)

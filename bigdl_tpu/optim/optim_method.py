@@ -1,0 +1,360 @@
+"""Optimization methods (ref optim/OptimMethod.scala:37-65, SGD.scala,
+Adagrad.scala, LBFGS.scala + LineSearch.scala).
+
+First-order methods are pure ``update`` functions over pytrees, designed to
+live inside one jitted train step (hyper-parameter schedules are traced
+functions of an iteration counter carried in the optimizer state, so one
+XLA program covers the whole run — no per-iteration recompile).
+
+LBFGS is host-driven over the flattened parameter vector with a strong-
+Wolfe line search, like the reference; each feval is still one jitted
+device computation.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --------------------------------------------------------------------- #
+# learning-rate schedules (ref optim/SGD.scala:127-208)                 #
+# --------------------------------------------------------------------- #
+class LearningRateSchedule:
+    def rate(self, base_lr, iteration, epoch):
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    """lr / (1 + iteration * decay) (Torch SGD default)."""
+
+    def __init__(self, decay: float = 0.0):
+        self.decay = decay
+
+    def rate(self, base_lr, iteration, epoch):
+        return base_lr / (1.0 + iteration * self.decay)
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - iter/max)^power; 0 beyond max (ref SGD.Poly)."""
+
+    def __init__(self, power: float, max_iteration: int):
+        self.power = power
+        self.max_iteration = max_iteration
+
+    def rate(self, base_lr, iteration, epoch):
+        frac = jnp.clip(iteration / self.max_iteration, 0.0, 1.0)
+        return base_lr * (1.0 - frac) ** self.power
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(iter / step_size)) (ref SGD.Step)."""
+
+    def __init__(self, step_size: int, gamma: float):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def rate(self, base_lr, iteration, epoch):
+        return base_lr * self.gamma ** jnp.floor(iteration / self.step_size)
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^(floor((epoch-1) / step)) (ref SGD.EpochStep)."""
+
+    def __init__(self, step: int, gamma: float):
+        self.step = step
+        self.gamma = gamma
+
+    def rate(self, base_lr, iteration, epoch):
+        return base_lr * self.gamma ** jnp.floor((epoch - 1) / self.step)
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decay(epoch) with a user decay function (ref SGD.EpochDecay).
+    The function must be jnp-traceable (epoch arrives as a traced scalar)."""
+
+    def __init__(self, decay_fn: Callable):
+        self.decay_fn = decay_fn
+
+    def rate(self, base_lr, iteration, epoch):
+        return base_lr * 0.1 ** self.decay_fn(epoch)
+
+
+class Regime:
+    def __init__(self, start_epoch: int, end_epoch: int, config: dict):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.config = config
+
+
+class EpochSchedule(LearningRateSchedule):
+    """Piecewise-constant lr by epoch regime (ref SGD.EpochSchedule)."""
+
+    def __init__(self, regimes: list[Regime]):
+        self.regimes = regimes
+
+    def rate(self, base_lr, iteration, epoch):
+        lr = base_lr
+        for r in self.regimes:
+            in_regime = (epoch >= r.start_epoch) & (epoch <= r.end_epoch)
+            lr = jnp.where(in_regime, r.config.get("learning_rate", base_lr), lr)
+        return lr
+
+
+# --------------------------------------------------------------------- #
+# OptimMethod base                                                      #
+# --------------------------------------------------------------------- #
+class OptimMethod:
+    """Functional optimizer: init_state + update (jit-composable), plus a
+    host-level ``optimize(feval, x)`` mirroring the reference signature."""
+
+    def init_state(self, params):
+        return {"iteration": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params, epoch=1):
+        """-> (new_params, new_state). Pure; safe inside jit/shard_map."""
+        raise NotImplementedError
+
+    def optimize(self, feval: Callable, x, epoch: int = 1):
+        """One step given feval: x -> (loss, grad) (ref OptimMethod.optimize).
+        Keeps per-method state on the instance like the reference's state
+        Table."""
+        if not hasattr(self, "_state") or self._state is None:
+            self._state = self.init_state(x)
+        loss, grad = feval(x)
+        x, self._state = self.update(grad, self._state, x, epoch=epoch)
+        return x, [loss]
+
+    def clear_history(self) -> None:
+        self._state = None
+
+    def get_hyper_parameter(self) -> str:
+        return ""
+
+
+class SGD(OptimMethod):
+    """SGD with momentum/nesterov/weight-decay and lr schedules
+    (ref optim/SGD.scala:25-127).  Semantics follow Torch optim.sgd:
+    v = mu*v + (1-dampening)*g ; g = g + mu*v (nesterov) or v."""
+
+    def __init__(self, learning_rate: float = 1e-3, learning_rate_decay: float = 0.0,
+                 weight_decay: float = 0.0, momentum: float = 0.0,
+                 dampening: Optional[float] = None, nesterov: bool = False,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        self.learning_rate = learning_rate
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        # Torch-Lua/BigDL default: dampening = momentum (ref SGD.scala:39),
+        # except under nesterov which requires dampening = 0.  Pass
+        # dampening=0.0 explicitly for PyTorch-style heavy-ball SGD.
+        self.dampening = dampening if dampening is not None else (
+            0.0 if nesterov else momentum)
+        self.nesterov = nesterov
+        if nesterov and (momentum <= 0 or self.dampening != 0.0):
+            raise ValueError("nesterov requires momentum > 0 and dampening = 0")
+        self.schedule = learning_rate_schedule or Default(learning_rate_decay)
+
+    def init_state(self, params):
+        state = {"iteration": jnp.zeros((), jnp.int32)}
+        if self.momentum > 0:
+            state["velocity"] = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return state
+
+    def current_rate(self, state, epoch=1):
+        return self.schedule.rate(self.learning_rate, state["iteration"], epoch)
+
+    def update(self, grads, state, params, epoch=1):
+        lr = self.current_rate(state, epoch)
+        damp = self.dampening
+
+        if self.weight_decay > 0:
+            grads = jax.tree_util.tree_map(
+                lambda g, w: g + self.weight_decay * w, grads, params)
+        if self.momentum > 0:
+            new_v = jax.tree_util.tree_map(
+                lambda v, g: self.momentum * v + (1 - damp) * g,
+                state["velocity"], grads)
+            if self.nesterov:
+                step_dir = jax.tree_util.tree_map(
+                    lambda g, v: g + self.momentum * v, grads, new_v)
+            else:
+                step_dir = new_v
+            new_state = {"iteration": state["iteration"] + 1, "velocity": new_v}
+        else:
+            step_dir = grads
+            new_state = {"iteration": state["iteration"] + 1}
+        new_params = jax.tree_util.tree_map(lambda w, d: w - lr * d, params, step_dir)
+        return new_params, new_state
+
+    def get_hyper_parameter(self) -> str:
+        return f"Current learning rate is {self.learning_rate}. "
+
+
+class Adagrad(OptimMethod):
+    """Adagrad (ref optim/Adagrad.scala:25-78)."""
+
+    def __init__(self, learning_rate: float = 1e-3,
+                 learning_rate_decay: float = 0.0, eps: float = 1e-10):
+        self.learning_rate = learning_rate
+        self.learning_rate_decay = learning_rate_decay
+        self.eps = eps
+
+    def init_state(self, params):
+        return {"iteration": jnp.zeros((), jnp.int32),
+                "accum": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def update(self, grads, state, params, epoch=1):
+        lr = self.learning_rate / (1.0 + state["iteration"] * self.learning_rate_decay)
+        accum = jax.tree_util.tree_map(lambda a, g: a + g * g, state["accum"], grads)
+        new_params = jax.tree_util.tree_map(
+            lambda w, g, a: w - lr * g / (jnp.sqrt(a) + self.eps), params, grads, accum)
+        return new_params, {"iteration": state["iteration"] + 1, "accum": accum}
+
+
+# --------------------------------------------------------------------- #
+# LBFGS (ref optim/LBFGS.scala:38-280 + LineSearch.scala lswolfe)       #
+# --------------------------------------------------------------------- #
+def ls_wolfe(feval, x, t, d, f, g, gtd, c1=1e-4, c2=0.9, tol_x=1e-9,
+             max_iter=20):
+    """Strong-Wolfe cubic-interpolation line search (ref LineSearch.scala).
+    Works on flat jnp vectors; feval returns (f, g)."""
+    d_norm = float(jnp.max(jnp.abs(d)))
+    g = jnp.asarray(g)
+    # bracket phase
+    t_prev, f_prev, g_prev, gtd_prev = 0.0, f, g, gtd
+    ls_func_evals = 0
+    bracket = None
+    for _ in range(max_iter):
+        f_new, g_new = feval(x + t * d)
+        ls_func_evals += 1
+        gtd_new = float(jnp.vdot(g_new, d))
+        if f_new > (f + c1 * t * gtd) or (ls_func_evals > 1 and f_new >= f_prev):
+            bracket = (t_prev, t, f_prev, f_new, g_prev, g_new, gtd_prev, gtd_new)
+            break
+        if abs(gtd_new) <= -c2 * gtd:
+            return f_new, g_new, t, ls_func_evals
+        if gtd_new >= 0:
+            bracket = (t_prev, t, f_prev, f_new, g_prev, g_new, gtd_prev, gtd_new)
+            break
+        t_prev, f_prev, g_prev, gtd_prev = t, f_new, g_new, gtd_new
+        t = min(10.0, t * 2.0)
+    if bracket is None:
+        return f_new, g_new, t, ls_func_evals
+    # zoom phase
+    lo_t, hi_t, lo_f, hi_f, lo_g, hi_g, lo_gtd, hi_gtd = bracket
+    for _ in range(max_iter):
+        if abs(hi_t - lo_t) * d_norm < tol_x:
+            break
+        t = (lo_t + hi_t) / 2.0
+        f_new, g_new = feval(x + t * d)
+        ls_func_evals += 1
+        gtd_new = float(jnp.vdot(g_new, d))
+        if f_new > (f + c1 * t * gtd) or f_new >= lo_f:
+            hi_t, hi_f, hi_g, hi_gtd = t, f_new, g_new, gtd_new
+        else:
+            if abs(gtd_new) <= -c2 * gtd:
+                return f_new, g_new, t, ls_func_evals
+            if gtd_new * (hi_t - lo_t) >= 0:
+                hi_t, hi_f, hi_g, hi_gtd = lo_t, lo_f, lo_g, lo_gtd
+            lo_t, lo_f, lo_g, lo_gtd = t, f_new, g_new, gtd_new
+    return f_new, g_new, t, ls_func_evals
+
+
+class LBFGS(OptimMethod):
+    """Limited-memory BFGS with optional strong-Wolfe line search
+    (ref optim/LBFGS.scala).  Host-driven loop; each feval is one device
+    computation on the flattened parameter vector."""
+
+    def __init__(self, max_iter: int = 20, max_eval: Optional[float] = None,
+                 tol_fun: float = 1e-5, tol_x: float = 1e-9,
+                 n_correction: int = 100, learning_rate: float = 1.0,
+                 line_search: bool = False):
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 1.25
+        self.tol_fun = tol_fun
+        self.tol_x = tol_x
+        self.n_correction = n_correction
+        self.learning_rate = learning_rate
+        self.line_search = line_search
+        self._state: Optional[dict] = None
+
+    def clear_history(self):
+        self._state = None
+
+    def optimize(self, feval: Callable, x, epoch: int = 1):
+        """Run up to max_iter LBFGS iterations from x (one reference
+        `optimize` call = one outer loop).  Returns (x, loss_history)."""
+        x = jnp.asarray(x)
+        st = self._state if self._state is not None else {
+            "old_dirs": [], "old_steps": [], "prev_g": None, "prev_loss": None,
+            "d": None, "t": None, "hdiag": 1.0, "func_evals": 0}
+        f, g = feval(x)
+        f_hist = [float(f)]
+        st["func_evals"] += 1
+        abs_grad_sum = float(jnp.sum(jnp.abs(g)))
+        if abs_grad_sum <= self.tol_fun:
+            self._state = st
+            return x, f_hist
+
+        for n_iter in range(self.max_iter):
+            if st["prev_g"] is None:
+                d = -g
+                st["hdiag"] = 1.0
+            else:
+                y = g - st["prev_g"]
+                s = st["d"] * st["t"]
+                ys = float(jnp.vdot(y, s))
+                if ys > 1e-10:
+                    if len(st["old_dirs"]) == self.n_correction:
+                        st["old_dirs"].pop(0)
+                        st["old_steps"].pop(0)
+                    st["old_dirs"].append(s)
+                    st["old_steps"].append(y)
+                    st["hdiag"] = ys / float(jnp.vdot(y, y))
+                # two-loop recursion
+                k = len(st["old_dirs"])
+                ro = [1.0 / float(jnp.vdot(st["old_steps"][i], st["old_dirs"][i]))
+                      for i in range(k)]
+                al = [0.0] * k
+                q = -g
+                for i in range(k - 1, -1, -1):
+                    al[i] = float(jnp.vdot(st["old_dirs"][i], q)) * ro[i]
+                    q = q - al[i] * st["old_steps"][i]
+                d = q * st["hdiag"]
+                for i in range(k):
+                    be = float(jnp.vdot(st["old_steps"][i], d)) * ro[i]
+                    d = d + st["old_dirs"][i] * (al[i] - be)
+            st["prev_g"] = g
+            gtd = float(jnp.vdot(g, d))
+            if gtd > -self.tol_x:
+                break
+            if n_iter == 0 and st["prev_loss"] is None:
+                t = min(1.0, 1.0 / max(abs_grad_sum, 1e-12)) * self.learning_rate
+            else:
+                t = self.learning_rate
+            if self.line_search:
+                f, g, t, evals = ls_wolfe(feval, x, t, d, float(f), g, gtd)
+                x = x + t * d
+                st["func_evals"] += evals
+            else:
+                x = x + t * d
+                f, g = feval(x)
+                st["func_evals"] += 1
+            st["d"], st["t"] = d, t
+            f_hist.append(float(f))
+            abs_grad_sum = float(jnp.sum(jnp.abs(g)))
+            if abs_grad_sum <= self.tol_fun:
+                break
+            if float(jnp.max(jnp.abs(d * t))) <= self.tol_x:
+                break
+            if st["prev_loss"] is not None and \
+                    abs(f_hist[-1] - f_hist[-2]) < self.tol_fun:
+                break
+            if st["func_evals"] >= self.max_eval:
+                break
+        st["prev_loss"] = f_hist[-1]
+        self._state = st
+        return x, f_hist

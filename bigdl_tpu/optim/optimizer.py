@@ -1,0 +1,294 @@
+"""Optimizer builder + single-chip training loop (ref optim/Optimizer.scala:
+29-201, optim/LocalOptimizer.scala:76-173) and standalone validators
+(ref optim/Validator.scala, LocalValidator.scala).
+
+The reference's LocalOptimizer clones `coreNumber` thread-replicas that
+alias one flattened weight storage and sum gradients slice-parallel.  On a
+TPU chip none of that exists: ONE jitted train step (forward, backward,
+optimizer update fused into a single XLA program, parameters donated so
+updates are in-place in HBM) is the whole hot loop.  The distributed loop
+lives in bigdl_tpu.parallel.distri_optimizer.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.dataset.dataset import AbstractDataSet
+from bigdl_tpu.nn.module import Criterion, Module
+from bigdl_tpu.optim.metrics import Metrics
+from bigdl_tpu.optim.optim_method import LBFGS, OptimMethod, SGD
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.validation import ValidationMethod
+
+log = logging.getLogger("bigdl_tpu.optim")
+
+
+class Optimizer:
+    """Builder API (ref optim/Optimizer.scala:29-144).  The factory
+    dispatches Local vs Distri on the dataset type, like the reference's
+    apply (Optimizer.scala:166-201)."""
+
+    def __init__(self, model: Module, dataset: AbstractDataSet, criterion: Criterion):
+        self.model = model
+        self.dataset = dataset
+        self.criterion = criterion
+        self.optim_method: OptimMethod = SGD()
+        self.end_when: Trigger = Trigger.max_iteration(100)
+        self.checkpoint_path: Optional[str] = None
+        self.checkpoint_trigger: Optional[Trigger] = None
+        self.validation_trigger: Optional[Trigger] = None
+        self.validation_dataset: Optional[AbstractDataSet] = None
+        self.validation_methods: Sequence[ValidationMethod] = ()
+        self.train_summary = None
+        self.validation_summary = None
+        self.state: dict = {}
+        self.metrics = Metrics()
+
+    # -- builder methods (reference names, pythonized) ------------------- #
+    def set_optim_method(self, method: OptimMethod) -> "Optimizer":
+        self.optim_method = method
+        return self
+
+    def set_end_when(self, trigger: Trigger) -> "Optimizer":
+        self.end_when = trigger
+        return self
+
+    def set_state(self, state: dict) -> "Optimizer":
+        self.state = dict(state)
+        return self
+
+    def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
+        if not os.path.isdir(path):
+            raise ValueError(f"checkpoint path {path} is not a directory")
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger
+        return self
+
+    def set_validation(self, trigger: Trigger, dataset: AbstractDataSet,
+                       methods: Sequence[ValidationMethod]) -> "Optimizer":
+        self.validation_trigger = trigger
+        self.validation_dataset = dataset
+        self.validation_methods = methods
+        return self
+
+    def set_train_summary(self, summary) -> "Optimizer":
+        self.train_summary = summary
+        return self
+
+    def set_validation_summary(self, summary) -> "Optimizer":
+        self.validation_summary = summary
+        return self
+
+    @staticmethod
+    def create(model: Module, dataset: AbstractDataSet, criterion: Criterion) -> "Optimizer":
+        from bigdl_tpu.dataset.dataset import DistributedDataSet, TransformedDataSet
+        src = dataset
+        while isinstance(src, TransformedDataSet):
+            src = src.source
+        if isinstance(src, DistributedDataSet):
+            try:
+                from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+            except ImportError as e:  # pragma: no cover
+                raise RuntimeError(
+                    "distributed training requires bigdl_tpu.parallel") from e
+            return DistriOptimizer(model, dataset, criterion)
+        return LocalOptimizer(model, dataset, criterion)
+
+    # -- shared loop plumbing ------------------------------------------- #
+    def _init_driver_state(self):
+        self.state.setdefault("epoch", 1)
+        self.state.setdefault("neval", 1)
+        self.state.setdefault("records_processed", 0)
+        self.state["epoch_finished"] = False
+
+    def _maybe_validate(self):
+        if (self.validation_trigger is not None and self.validation_dataset is not None
+                and self.validation_trigger(self.state)):
+            results = self._validate()
+            for method, result in results:
+                log.info("%s is %s", method, result)
+                if self.validation_summary is not None:
+                    value = result.result()[0]
+                    self.validation_summary.add_scalar(
+                        str(method), value, self.state["neval"] - 1)
+            return results
+        return None
+
+    def _validate(self):
+        raise NotImplementedError
+
+    def _maybe_checkpoint(self):
+        if (self.checkpoint_trigger is not None and self.checkpoint_path is not None
+                and self.checkpoint_trigger(self.state)):
+            self._checkpoint()
+
+    def _checkpoint(self):
+        """Write model.<neval> + state.<neval> (ref Optimizer.saveModel/
+        saveState, DistriOptimizer.scala:334-356)."""
+        from bigdl_tpu.utils import file_io
+        n = self.state["neval"] - 1
+        self.model.save(os.path.join(self.checkpoint_path, f"model.{n}"), overwrite=True)
+        opt_state = getattr(self.optim_method, "_state", None)
+        host_state = dict(self.state)
+        file_io.save({"driver_state": host_state,
+                      "optim_state": jax.tree_util.tree_map(
+                          lambda a: a, opt_state) if opt_state is not None else None},
+                     os.path.join(self.checkpoint_path, f"state.{n}"), overwrite=True)
+        log.info("checkpoint written at iteration %d", n)
+
+
+class LocalOptimizer(Optimizer):
+    """Single-process training loop (ref optim/LocalOptimizer.scala:76-173).
+
+    The dataset must yield MiniBatch (data, labels); one jitted step does
+    forward+backward+update with donated params for in-HBM updates.
+    """
+
+    def __init__(self, model: Module, dataset: AbstractDataSet, criterion: Criterion):
+        super().__init__(model, dataset, criterion)
+        self._step_fn = None
+
+    def _build_step(self):
+        model, criterion, method = self.model, self.criterion, self.optim_method
+
+        def loss_fn(params, buffers, data, labels, rng):
+            out, new_buffers = model.apply(params, data, buffers=buffers,
+                                           training=True, rng=rng)
+            return criterion.loss(out, labels), new_buffers
+
+        def step(params, buffers, opt_state, data, labels, rng, epoch):
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, buffers, data, labels, rng)
+            new_params, new_opt_state = method.update(grads, opt_state, params,
+                                                      epoch=epoch)
+            return new_params, new_buffers, new_opt_state, loss
+
+        return jax.jit(step, donate_argnums=(0, 2))
+
+    def optimize(self) -> Module:
+        self._init_driver_state()
+        self.model._built()
+        params, buffers = self.model.params, self.model.buffers
+        opt_state = self.optim_method.init_state(params)
+        if isinstance(self.optim_method, LBFGS):
+            return self._optimize_lbfgs()
+        self._step_fn = self._build_step()
+        rng = jax.random.PRNGKey(self.state.get("seed", 0))
+        dataset_size = self.dataset.size()
+        self.dataset.shuffle()
+        data_iter = self.dataset.data(train=True)
+
+        records_this_epoch = self.state.get("records_processed", 0)
+        wall0 = time.perf_counter()
+        while not self.end_when(self.state):
+            self.state["epoch_finished"] = False
+            batch = next(data_iter)
+            rng, sub = jax.random.split(rng)
+            t0 = time.perf_counter()
+            params, buffers, opt_state, loss = self._step_fn(
+                params, buffers, opt_state,
+                jnp.asarray(batch.data), jnp.asarray(batch.labels), sub,
+                self.state["epoch"])
+            loss_val = float(loss)  # syncs; also what the reference logs
+            dt = time.perf_counter() - t0
+            bs = batch.data.shape[0]
+            records_this_epoch += bs
+            self.metrics.add("computing time", dt)
+            self.state["loss"] = loss_val
+            self.state["throughput"] = bs / dt
+            log.info("Epoch %d iteration %d: loss %.6f, throughput %.1f records/s",
+                     self.state["epoch"], self.state["neval"], loss_val, bs / dt)
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Loss", loss_val, self.state["neval"])
+                self.train_summary.add_scalar("Throughput", bs / dt, self.state["neval"])
+            self.state["neval"] += 1
+            if records_this_epoch >= dataset_size:  # epoch rollover
+                self.state["epoch"] += 1
+                self.state["epoch_finished"] = True
+                records_this_epoch = 0
+                self.dataset.shuffle()
+                data_iter = self.dataset.data(train=True)
+            # publish params so validation/checkpoint see current weights
+            self.model.params, self.model.buffers = params, buffers
+            self.optim_method._state = opt_state
+            self._maybe_validate()
+            self._maybe_checkpoint()
+        self.state["records_processed"] = records_this_epoch
+        log.info("training finished in %.1fs", time.perf_counter() - wall0)
+        self.model.params, self.model.buffers = params, buffers
+        return self.model
+
+    def _optimize_lbfgs(self) -> Module:
+        """Full-batch path for LBFGS (the reference drives LBFGS through the
+        same feval machinery, optim/LocalOptimizer + LBFGS.scala)."""
+        from jax.flatten_util import ravel_pytree
+        model, criterion = self.model, self.criterion
+        flat0, unravel = ravel_pytree(model.params)
+        buffers = model.buffers
+
+        batch = next(self.dataset.data(train=True))
+        data, labels = jnp.asarray(batch.data), jnp.asarray(batch.labels)
+
+        @jax.jit
+        def val_and_grad(flat):
+            def loss_fn(fl):
+                out, _ = model.apply(unravel(fl), data, buffers=buffers, training=True)
+                return criterion.loss(out, labels)
+            return jax.value_and_grad(loss_fn)(flat)
+
+        def feval(flat):
+            v, g = val_and_grad(flat)
+            return float(v), g
+
+        flat = flat0
+        while not self.end_when(self.state):
+            self.state["epoch_finished"] = False
+            flat, hist = self.optim_method.optimize(feval, flat)
+            self.state["loss"] = hist[-1]
+            log.info("LBFGS iteration %d: loss %.6f", self.state["neval"], hist[-1])
+            self.state["neval"] += 1
+            model.params = unravel(flat)
+            self._maybe_validate()
+            self._maybe_checkpoint()
+        return model
+
+    def _validate(self):
+        return LocalValidator(self.model, self.validation_dataset).test(
+            self.validation_methods)
+
+
+class Validator:
+    """Standalone evaluation (ref optim/Validator.scala:23-31)."""
+
+    def __init__(self, model: Module, dataset: AbstractDataSet):
+        self.model = model
+        self.dataset = dataset
+
+
+class LocalValidator(Validator):
+    """(ref optim/LocalValidator.scala:29) — eval-mode forward over the
+    dataset, monoid-reduce the per-batch results."""
+
+    def test(self, methods: Sequence[ValidationMethod]):
+        model = self.model
+        model._built()
+
+        @jax.jit
+        def fwd(params, buffers, data):
+            out, _ = model.apply(params, data, buffers=buffers, training=False)
+            return out
+
+        totals = [None] * len(methods)
+        for batch in self.dataset.data(train=False):
+            out = fwd(model.params, model.buffers, jnp.asarray(batch.data))
+            labels = jnp.asarray(batch.labels)
+            for i, m in enumerate(methods):
+                r = m(out, labels)
+                totals[i] = r if totals[i] is None else totals[i] + r
+        return list(zip(methods, totals))

@@ -1,0 +1,95 @@
+"""Validation methods and monoid results (ref optim/ValidationMethod.scala:
+27-218, optim/EvaluateMethods.scala).
+
+Results support ``+`` so per-batch (and per-device, via psum upstream)
+results reduce associatively, exactly like the reference's monoid reduce
+over partitions (DistriOptimizer.scala:462-532).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class ValidationResult:
+    def result(self) -> tuple[float, int]:
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct: int, count: int):
+        self.correct = int(correct)
+        self.count = int(count)
+
+    def result(self):
+        return (self.correct / max(self.count, 1), self.count)
+
+    def __add__(self, other: "AccuracyResult") -> "AccuracyResult":
+        return AccuracyResult(self.correct + other.correct, self.count + other.count)
+
+    def __repr__(self):
+        acc, n = self.result()
+        return f"Accuracy(correct: {self.correct}, count: {n}, accuracy: {acc:.6f})"
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss: float, count: int):
+        self.loss = float(loss)
+        self.count = int(count)
+
+    def result(self):
+        return (self.loss / max(self.count, 1), self.count)
+
+    def __add__(self, other: "LossResult") -> "LossResult":
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        avg, n = self.result()
+        return f"Loss(sum: {self.loss:.4f}, count: {n}, mean: {avg:.6f})"
+
+
+class ValidationMethod:
+    name = "validation"
+
+    def __call__(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.name
+
+
+class Top1Accuracy(ValidationMethod):
+    """argmax(output)+1 == 1-based target (ref ValidationMethod.scala:90)."""
+    name = "Top1Accuracy"
+
+    def __call__(self, output, target) -> AccuracyResult:
+        pred = jnp.argmax(output, axis=-1) + 1
+        t = jnp.asarray(target).astype(jnp.int32).reshape(pred.shape)
+        correct = int(jnp.sum(pred.astype(jnp.int32) == t))
+        return AccuracyResult(correct, int(np.prod(pred.shape)))
+
+
+class Top5Accuracy(ValidationMethod):
+    name = "Top5Accuracy"
+
+    def __call__(self, output, target) -> AccuracyResult:
+        out = jnp.asarray(output)
+        top5 = jnp.argsort(-out, axis=-1)[..., :5] + 1
+        t = jnp.asarray(target).astype(jnp.int32).reshape(top5.shape[:-1] + (1,))
+        correct = int(jnp.sum(jnp.any(top5.astype(jnp.int32) == t, axis=-1)))
+        return AccuracyResult(correct, int(np.prod(top5.shape[:-1])))
+
+
+class Loss(ValidationMethod):
+    """Criterion value over the batch (ref ValidationMethod.scala:207)."""
+    name = "Loss"
+
+    def __init__(self, criterion=None):
+        from bigdl_tpu.nn.criterions import ClassNLLCriterion
+        self.criterion = criterion if criterion is not None else ClassNLLCriterion()
+
+    def __call__(self, output, target) -> LossResult:
+        return LossResult(float(self.criterion.loss(output, target)), 1)

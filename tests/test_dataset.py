@@ -1,0 +1,176 @@
+"""Data pipeline tests (ref dataset/ transformer specs)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu.dataset import (
+    DataSet, MiniBatch, Sample, ByteRecord, cifar, mnist,
+)
+from bigdl_tpu.dataset.dataset import DistributedDataSet, LocalArrayDataSet
+from bigdl_tpu.dataset.seqfile import read_shard, write_shard, write_sharded
+from bigdl_tpu.dataset.transformer import FuncTransformer, Prefetcher, SampleToBatch
+from bigdl_tpu.dataset import image, text
+from bigdl_tpu.dataset.types import LabeledImage, LabeledSentence
+
+
+class TestDataSetCore:
+    def test_local_array_infinite_train(self):
+        ds = DataSet.array([1, 2, 3])
+        it = ds.data(train=True)
+        got = [next(it) for _ in range(7)]
+        assert len(got) == 7 and set(got) <= {1, 2, 3}
+
+    def test_eval_one_pass(self):
+        ds = DataSet.array([1, 2, 3])
+        assert list(ds.data(train=False)) == [1, 2, 3]
+
+    def test_shuffle_changes_order(self):
+        ds = DataSet.array(list(range(100)))
+        ds.shuffle()
+        it = ds.data(train=True)
+        first_pass = [next(it) for _ in range(100)]
+        assert first_pass != list(range(100))
+        assert sorted(first_pass) == list(range(100))
+
+    def test_transform_chain(self):
+        ds = DataSet.array([Sample(np.ones(3) * i, np.asarray(i)) for i in range(10)])
+        batched = ds >> SampleToBatch(4)
+        batches = list(batched.data(train=False))
+        assert len(batches) == 3
+        assert batches[0].data.shape == (4, 3)
+        assert batches[2].data.shape == (2, 3)
+
+    def test_distributed_sharding(self):
+        ds = DistributedDataSet(list(range(10)), process_index=1, process_count=4)
+        assert ds.size() == 10
+        assert sorted(ds.local.records) == [1, 5, 9]
+
+
+class TestSampleToBatch:
+    def test_padding(self):
+        samples = [Sample(np.ones(n), np.ones(n)) for n in (3, 5, 2)]
+        tr = SampleToBatch(3, feature_padding=0.0, label_padding=-1.0)
+        (b,) = list(tr(iter(samples)))
+        assert b.data.shape == (3, 5)
+        assert b.labels.shape == (3, 5)
+        assert b.data[2, 2] == 0.0 and b.labels[2, 2] == -1.0
+
+    def test_fixed_length(self):
+        samples = [Sample(np.ones(3), np.ones(1)) for _ in range(2)]
+        tr = SampleToBatch(2, feature_padding=0.0, label_padding=0.0, fixed_length=8)
+        (b,) = list(tr(iter(samples)))
+        assert b.data.shape == (2, 8)
+
+    def test_prefetcher_preserves_stream(self):
+        src = list(range(50))
+        out = list(Prefetcher(4)(iter(src)))
+        assert out == src
+
+
+class TestSeqFile:
+    def test_roundtrip(self, tmp_path):
+        recs = [ByteRecord(bytes([i] * 10), float(i)) for i in range(20)]
+        p = str(tmp_path / "shard-0")
+        n = write_shard(p, recs)
+        assert n == 20
+        back = list(read_shard(p))
+        assert len(back) == 20
+        assert back[3].data == bytes([3] * 10) and back[3].label == 3.0
+
+    def test_sharded(self, tmp_path):
+        recs = [ByteRecord(b"x" * 5, float(i)) for i in range(10)]
+        paths = write_sharded(str(tmp_path / "part"), recs, 3)
+        total = sum(len(list(read_shard(p))) for p in paths)
+        assert total == 10
+
+    def test_bad_magic(self, tmp_path):
+        p = tmp_path / "bad"
+        p.write_bytes(b"NOTAMAGIC")
+        with pytest.raises(ValueError):
+            list(read_shard(str(p)))
+
+
+class TestImageTransformers:
+    def test_bytes_to_grey(self):
+        rec = ByteRecord(np.arange(784, dtype=np.uint8).tobytes(), 3.0)
+        img = image.BytesToGreyImg(28, 28).transform_one(rec)
+        assert img.data.shape == (1, 28, 28) and img.label == 3.0
+
+    def test_normalizer(self):
+        img = LabeledImage(np.full((1, 4, 4), 10.0, dtype=np.float32), 1.0)
+        out = image.GreyImgNormalizer(10.0, 2.0).transform_one(img)
+        np.testing.assert_allclose(out.data, 0.0)
+
+    def test_bgr_normalizer(self):
+        img = LabeledImage(np.ones((3, 4, 4), dtype=np.float32), 1.0)
+        out = image.BGRImgNormalizer((1, 1, 1), (2, 2, 2)).transform_one(img)
+        np.testing.assert_allclose(out.data, 0.0)
+
+    def test_cropper(self):
+        img = LabeledImage(np.arange(3 * 8 * 8, dtype=np.float32).reshape(3, 8, 8), 1.0)
+        out = image.BGRImgCropper(4, 4).transform_one(img)
+        assert out.data.shape == (3, 4, 4)
+        out = image.BGRImgRdmCropper(5, 5).transform_one(img)
+        assert out.data.shape == (3, 5, 5)
+
+    def test_hflip(self):
+        img = LabeledImage(np.arange(4, dtype=np.float32).reshape(1, 1, 4), 1.0)
+        flipped = image.HFlip(threshold=1.1).transform_one(img)  # always flips
+        np.testing.assert_allclose(flipped.data[0, 0], [3, 2, 1, 0])
+
+    def test_grey_to_batch(self):
+        imgs = [LabeledImage(np.ones((1, 5, 5), dtype=np.float32), float(i)) for i in range(4)]
+        batches = list(image.GreyImgToBatch(2)(iter(imgs)))
+        assert len(batches) == 2
+        assert batches[0].data.shape == (2, 1, 5, 5)
+        assert batches[0].labels.shape == (2,)
+
+    def test_lighting_and_jitter_shapes(self):
+        img = LabeledImage(np.ones((3, 6, 6), dtype=np.float32), 1.0)
+        assert image.Lighting().transform_one(img).data.shape == (3, 6, 6)
+        assert image.ColorJitter().transform_one(img).data.shape == (3, 6, 6)
+
+
+class TestTextTransformers:
+    def test_pipeline(self):
+        docs = ["Hello world. This is a test!", "Another doc here."]
+        sentences = list(text.SentenceSplitter()(iter(docs)))
+        assert len(sentences) == 3
+        tokens = list(text.SentenceTokenizer()(iter(sentences)))
+        assert tokens[0] == ["hello", "world", "."]
+        padded = list(text.SentenceBiPadding()(iter(tokens)))
+        assert padded[0][0] == text.SENTENCE_START and padded[0][-1] == text.SENTENCE_END
+
+    def test_dictionary(self):
+        d = text.Dictionary([["a", "b", "a"], ["a", "c"]], vocab_size=2)
+        assert d.get_index("a") == 0  # most frequent
+        assert d.get_index("zzz") == d._unk_index
+        assert d.vocab_size() == 3
+
+    def test_dictionary_save_load(self, tmp_path):
+        d = text.Dictionary([["x", "y"]], vocab_size=10)
+        p = str(tmp_path / "vocab.json")
+        d.save(p)
+        d2 = text.Dictionary.load(p)
+        assert d2.get_index("x") == d.get_index("x")
+
+    def test_labeled_sentence_to_sample(self):
+        d = text.Dictionary([["a", "b", "c"]], vocab_size=5)
+        ls = text.TextToLabeledSentence(d).transform_one(["a", "b", "c"])
+        assert len(ls.data) == 2 and len(ls.label) == 2
+        s = text.LabeledSentenceToSample(d.vocab_size(), fixed_length=4).transform_one(ls)
+        assert s.feature.shape == (4, d.vocab_size())
+        assert s.label.shape == (4,)
+        assert s.label[0] == ls.label[0] + 1  # 1-based
+
+
+class TestSyntheticData:
+    def test_mnist_synthetic(self):
+        recs = mnist.synthetic(32)
+        assert len(recs) == 32
+        img = image.BytesToGreyImg(28, 28).transform_one(recs[0])
+        assert img.data.shape == (1, 28, 28)
+        assert 1.0 <= recs[5].label <= 10.0
+
+    def test_cifar_synthetic(self):
+        recs = cifar.synthetic(16)
+        assert recs[0].data.shape == (3, 32, 32)

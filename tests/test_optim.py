@@ -1,0 +1,220 @@
+"""Optimization engine tests (ref optim/ specs: SGD/Adagrad/LBFGS specs,
+TriggerSpec, ValidationSpec, LocalOptimizerSpec with the reference-
+optimizer-equivalence strategy: compare against a naive update)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.dataset import DataSet, Sample
+from bigdl_tpu.dataset.transformer import SampleToBatch
+from bigdl_tpu.optim import (
+    SGD, Adagrad, LBFGS, Default, Poly, Step, EpochStep, EpochSchedule, Regime,
+    Trigger, Top1Accuracy, Top5Accuracy, Loss, LocalOptimizer, LocalValidator,
+    Optimizer,
+)
+
+
+class TestSGD:
+    def test_plain_matches_reference_update(self):
+        """Ref-optimizer equivalence (ref optim/RefLocalOptimizer.scala):
+        w' = w - lr*g."""
+        sgd = SGD(learning_rate=0.1)
+        params = {"w": jnp.asarray([1.0, 2.0])}
+        grads = {"w": jnp.asarray([0.5, -1.0])}
+        state = sgd.init_state(params)
+        new_params, _ = sgd.update(grads, state, params)
+        np.testing.assert_allclose(np.asarray(new_params["w"]), [0.95, 2.1], rtol=1e-6)
+
+    def test_momentum_matches_torch(self):
+        import torch
+        w0 = np.array([1.0, -2.0, 3.0], dtype=np.float32)
+        g_seq = [np.array([0.1, 0.2, -0.3], dtype=np.float32),
+                 np.array([-0.2, 0.1, 0.4], dtype=np.float32),
+                 np.array([0.3, -0.1, 0.2], dtype=np.float32)]
+        tw = torch.tensor(w0.copy(), requires_grad=True)
+        topt = torch.optim.SGD([tw], lr=0.05, momentum=0.9, weight_decay=0.01)
+        sgd = SGD(learning_rate=0.05, momentum=0.9, weight_decay=0.01, dampening=0.0)
+        params = {"w": jnp.asarray(w0)}
+        state = sgd.init_state(params)
+        for g in g_seq:
+            tw.grad = torch.tensor(g.copy())
+            topt.step()
+            params, state = sgd.update({"w": jnp.asarray(g)}, state, params)
+        np.testing.assert_allclose(np.asarray(params["w"]), tw.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_nesterov_matches_torch(self):
+        import torch
+        w0 = np.array([0.5, -0.5], dtype=np.float32)
+        tw = torch.tensor(w0.copy())
+        topt = torch.optim.SGD([tw], lr=0.1, momentum=0.9, nesterov=True)
+        sgd = SGD(learning_rate=0.1, momentum=0.9, nesterov=True)
+        params = {"w": jnp.asarray(w0)}
+        state = sgd.init_state(params)
+        for i in range(4):
+            g = np.array([0.1 * (i + 1), -0.05], dtype=np.float32)
+            tw.grad = torch.tensor(g.copy())
+            topt.step()
+            params, state = sgd.update({"w": jnp.asarray(g)}, state, params)
+        np.testing.assert_allclose(np.asarray(params["w"]), tw.numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_schedules(self):
+        assert float(Default(0.1).rate(1.0, 10, 1)) == pytest.approx(1.0 / 2.0)
+        assert float(Poly(2.0, 100).rate(1.0, 50, 1)) == pytest.approx(0.25)
+        assert float(Step(10, 0.5).rate(1.0, 25, 1)) == pytest.approx(0.25)
+        assert float(EpochStep(2, 0.1).rate(1.0, 0, 5)) == pytest.approx(0.01)
+        sched = EpochSchedule([Regime(1, 3, {"learning_rate": 1e-2}),
+                               Regime(4, 7, {"learning_rate": 5e-3})])
+        assert float(sched.rate(0.1, 0, 5)) == pytest.approx(5e-3)
+
+
+class TestAdagrad:
+    def test_matches_torch(self):
+        import torch
+        w0 = np.array([1.0, 2.0], dtype=np.float32)
+        tw = torch.tensor(w0.copy())
+        topt = torch.optim.Adagrad([tw], lr=0.1, eps=1e-10)
+        ours = Adagrad(learning_rate=0.1)
+        params = {"w": jnp.asarray(w0)}
+        state = ours.init_state(params)
+        for i in range(3):
+            g = np.array([0.5, -0.2 * (i + 1)], dtype=np.float32)
+            tw.grad = torch.tensor(g.copy())
+            topt.step()
+            params, state = ours.update({"w": jnp.asarray(g)}, state, params)
+        np.testing.assert_allclose(np.asarray(params["w"]), tw.numpy(), rtol=1e-5, atol=1e-6)
+
+
+class TestLBFGS:
+    def test_rosenbrock(self):
+        """Classic LBFGS sanity check (the reference tests LBFGS on
+        rosenbrock too, optim/LBFGSSpec)."""
+        def feval(x):
+            v = 100.0 * (x[1] - x[0] ** 2) ** 2 + (1 - x[0]) ** 2
+            g = jax.grad(lambda xx: 100.0 * (xx[1] - xx[0] ** 2) ** 2 + (1 - xx[0]) ** 2)(x)
+            return float(v), g
+
+        x = jnp.asarray([-1.2, 1.0])
+        opt = LBFGS(max_iter=100, line_search=True)
+        x, hist = opt.optimize(feval, x)
+        assert hist[-1] < 1e-5
+        np.testing.assert_allclose(np.asarray(x), [1.0, 1.0], atol=1e-2)
+
+    def test_quadratic_no_linesearch(self):
+        A = jnp.asarray([[3.0, 0.5], [0.5, 1.0]])
+        b = jnp.asarray([1.0, -2.0])
+
+        def feval(x):
+            v = 0.5 * x @ A @ x - b @ x
+            return float(v), A @ x - b
+
+        opt = LBFGS(max_iter=50)
+        x, hist = opt.optimize(feval, jnp.zeros(2))
+        expected = np.linalg.solve(np.asarray(A), np.asarray(b))
+        np.testing.assert_allclose(np.asarray(x), expected, atol=1e-3)
+
+
+class TestTrigger:
+    def test_triggers(self):
+        assert Trigger.max_epoch(3)({"epoch": 4, "neval": 1})
+        assert not Trigger.max_epoch(3)({"epoch": 3, "neval": 1})
+        assert Trigger.max_iteration(10)({"epoch": 1, "neval": 11})
+        assert Trigger.several_iteration(5)({"epoch": 1, "neval": 10})
+        assert not Trigger.several_iteration(5)({"epoch": 1, "neval": 9})
+        assert Trigger.every_epoch()({"epoch_finished": True})
+
+
+class TestValidationMethods:
+    def test_top1(self):
+        out = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+        target = jnp.asarray([2.0, 1.0, 1.0])
+        r = Top1Accuracy()(out, target)
+        assert r.result() == (2 / 3, 3)
+
+    def test_top5(self):
+        out = jnp.asarray(np.random.RandomState(0).randn(4, 10))
+        target = jnp.asarray([float(np.argsort(-np.asarray(out[i]))[3] + 1) for i in range(4)])
+        r = Top5Accuracy()(out, target)
+        assert r.result()[0] == 1.0
+
+    def test_monoid_add(self):
+        from bigdl_tpu.optim.validation import AccuracyResult
+        r = AccuracyResult(3, 10) + AccuracyResult(2, 5)
+        assert r.result() == (5 / 15, 15)
+
+
+def _toy_regression_dataset(n=64, batch=16, seed=0):
+    rng = np.random.RandomState(seed)
+    W = np.array([[2.0, -1.0], [0.5, 1.5]], dtype=np.float32)
+    samples = []
+    for _ in range(n):
+        x = rng.randn(2).astype(np.float32)
+        samples.append(Sample(x, (W @ x).astype(np.float32)))
+    return DataSet.array(samples, seed=seed) >> SampleToBatch(batch)
+
+
+class TestLocalOptimizer:
+    def test_sgd_convergence(self):
+        """'Train with MSE and SGD should be good'
+        (ref optim/LocalOptimizerSpec)."""
+        model = nn.Linear(2, 2, with_bias=False)
+        ds = _toy_regression_dataset()
+        opt = LocalOptimizer(model, ds, nn.MSECriterion())
+        opt.set_optim_method(SGD(learning_rate=0.1)) \
+           .set_end_when(Trigger.max_iteration(100))
+        trained = opt.optimize()
+        w = np.asarray(trained.params["weight"])
+        np.testing.assert_allclose(w, [[2.0, -1.0], [0.5, 1.5]], atol=0.05)
+
+    def test_lbfgs_convergence(self):
+        """'Train with MSE and LBFGS should be good'
+        (ref optim/DistriOptimizerSpec.scala:130-141)."""
+        model = nn.Linear(2, 2, with_bias=False)
+        ds = _toy_regression_dataset(n=64, batch=64)
+        opt = LocalOptimizer(model, ds, nn.MSECriterion())
+        opt.set_optim_method(LBFGS(max_iter=20, line_search=True)) \
+           .set_end_when(Trigger.max_iteration(5))
+        trained = opt.optimize()
+        w = np.asarray(trained.params["weight"])
+        np.testing.assert_allclose(w, [[2.0, -1.0], [0.5, 1.5]], atol=0.02)
+
+    def test_classification_with_validation_and_checkpoint(self, tmp_path):
+        rng = np.random.RandomState(1)
+        samples = []
+        for i in range(80):
+            label = i % 2
+            x = rng.randn(4).astype(np.float32) + label * 2.5
+            samples.append(Sample(x, np.asarray(label + 1.0, dtype=np.float32)))
+        train = DataSet.array(samples[:64], seed=1) >> SampleToBatch(16)
+        val = DataSet.array(samples[64:], seed=1) >> SampleToBatch(16)
+        model = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2), nn.LogSoftMax())
+        opt = LocalOptimizer(model, train, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learning_rate=0.5)) \
+           .set_end_when(Trigger.max_epoch(6)) \
+           .set_validation(Trigger.every_epoch(), val, [Top1Accuracy(), Loss()]) \
+           .set_checkpoint(str(tmp_path), Trigger.every_epoch())
+        trained = opt.optimize()
+        results = LocalValidator(trained, val).test([Top1Accuracy()])
+        acc = results[0][1].result()[0]
+        assert acc > 0.9
+        import os
+        assert any(f.startswith("model.") for f in os.listdir(tmp_path))
+        assert any(f.startswith("state.") for f in os.listdir(tmp_path))
+
+    def test_factory_dispatch(self):
+        ds = _toy_regression_dataset()
+        opt = Optimizer.create(nn.Linear(2, 2), ds, nn.MSECriterion())
+        assert isinstance(opt, LocalOptimizer)
+
+    def test_epoch_accounting(self):
+        model = nn.Linear(2, 2)
+        ds = _toy_regression_dataset(n=32, batch=16)
+        opt = LocalOptimizer(model, ds, nn.MSECriterion())
+        opt.set_optim_method(SGD(learning_rate=0.01)) \
+           .set_end_when(Trigger.max_epoch(3))
+        opt.optimize()
+        assert opt.state["epoch"] == 4  # stopped after finishing 3 epochs
+        assert opt.state["neval"] == 3 * 2 + 1

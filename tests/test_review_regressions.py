@@ -89,3 +89,42 @@ def test_lstm_gate_dropout_active():
     # two different keys -> different outputs
     y_train2, _ = m.apply(params, x, training=True, rng=jax.random.PRNGKey(2))
     assert not np.allclose(np.asarray(y_train), np.asarray(y_train2))
+
+
+def test_prefetcher_propagates_errors():
+    from bigdl_tpu.dataset.transformer import Prefetcher
+
+    def bad_gen():
+        yield 1
+        yield 2
+        raise RuntimeError("decode failed")
+
+    it = Prefetcher(2)(bad_gen())
+    assert next(it) == 1 and next(it) == 2
+    with pytest.raises(RuntimeError, match="decode failed"):
+        next(it)
+
+
+def test_dictionary_empty_constructor():
+    from bigdl_tpu.dataset.text import Dictionary
+    d = Dictionary()
+    assert d.get_index("anything") == 0  # unk
+
+
+def test_sgd_dampening_default_is_momentum():
+    from bigdl_tpu.optim import SGD
+    s = SGD(learning_rate=0.1, momentum=0.9)
+    assert s.dampening == 0.9  # Torch-Lua/BigDL default
+    s2 = SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+    assert s2.dampening == 0.0
+
+
+def test_label_padding_is_valid_class():
+    import numpy as np
+    from bigdl_tpu.dataset.text import LabeledSentenceToSample
+    from bigdl_tpu.dataset.types import LabeledSentence
+    tr = LabeledSentenceToSample(5, fixed_length=6, pad_label=3.0)
+    s = tr.transform_one(LabeledSentence(np.asarray([0.0, 1.0]), np.asarray([1.0, 2.0])))
+    assert s.label.tolist() == [2.0, 3.0, 3.0, 3.0, 3.0, 3.0]
+    with pytest.raises(ValueError):
+        LabeledSentenceToSample(5, pad_label=0.0)
