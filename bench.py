@@ -1,0 +1,86 @@
+"""Benchmark entry: ResNet-50 ImageNet-shape training throughput on the
+available TPU chip(s).  Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline (BASELINE.md): >= 2000 images/sec/chip on v5e — the reference
+repo publishes no numbers of its own, so the target is the driver's.
+
+Recipe: bf16 compute (activations + conv/matmul weights feed the MXU in
+bf16), f32 master weights and optimizer state (the TPU rendering of the
+reference's 'fp16 for transport, f32 for state' split,
+parameters/AllReduceParameter.scala).  Timing syncs via a host transfer of
+the loss each window — on this backend ``block_until_ready`` alone does
+not guarantee completion.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import ResNet
+    from bigdl_tpu.optim import SGD
+
+    n_chips = jax.device_count()
+    batch = 128
+    model = ResNet(class_num=1000, depth=50, dataset="imagenet").build(seed=1)
+    criterion = nn.ClassNLLCriterion()
+    method = SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+
+    params, buffers = model.params, model.buffers
+    opt_state = method.init_state(params)
+    rng = jax.random.PRNGKey(0)
+
+    def cast_bf16(tree):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a, tree)
+
+    def loss_fn(params_f32, buffers, x, y, rng):
+        p16 = cast_bf16(params_f32)          # bf16 compute params
+        out, nb = model.apply(p16, x, buffers=buffers, training=True, rng=rng)
+        return criterion.loss(out.astype(jnp.float32), y), nb
+
+    @jax.jit
+    def step(params, buffers, opt_state, x, y, rng):
+        (loss, nb), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, buffers, x, y, rng)
+        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        new_params, new_opt = method.update(grads, opt_state, params)
+        return new_params, nb, new_opt, loss
+
+    x = jnp.asarray(np.random.RandomState(0).randn(batch, 3, 224, 224),
+                    jnp.bfloat16)
+    y = jnp.asarray(np.random.RandomState(1).randint(1, 1001, size=batch)
+                    .astype(np.float32))
+
+    # compile + warmup (first TPU compile is slow; subsequent cached)
+    for _ in range(3):
+        params, buffers, opt_state, loss = step(params, buffers, opt_state, x, y, rng)
+    _ = float(loss)  # hard sync
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, buffers, opt_state, loss = step(params, buffers, opt_state, x, y, rng)
+    _ = float(loss)  # hard sync: loss depends on the whole step chain
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * iters / dt
+    per_chip = imgs_per_sec / n_chips
+    baseline = 2000.0  # images/sec/chip target from BASELINE.md
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / baseline, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -247,17 +247,45 @@ class BGRImgToBatch(GreyImgToBatch):
     pass
 
 
+class _EnsureSize(Transformer):
+    """Force (C, height, width): center-crop if larger, bilinear-resize
+    otherwise.  Guarantees the static shape SampleToBatch (and XLA) needs."""
+
+    def __init__(self, width: int, height: int):
+        self.width = width
+        self.height = height
+
+    def transform_one(self, img: LabeledImage) -> LabeledImage:
+        c, h, w = img.data.shape
+        if (h, w) == (self.height, self.width):
+            return img
+        if h >= self.height and w >= self.width:
+            y0 = (h - self.height) // 2
+            x0 = (w - self.width) // 2
+            patch = img.data[:, y0:y0 + self.height, x0:x0 + self.width]
+            return LabeledImage(np.ascontiguousarray(patch), img.label)
+        from PIL import Image
+        hwc = img.data.transpose(1, 2, 0)
+        resized = np.stack([
+            np.asarray(Image.fromarray(hwc[:, :, i]).resize(
+                (self.width, self.height), Image.BILINEAR))
+            for i in range(c)])
+        return LabeledImage(resized.astype(np.float32), img.label)
+
+
 class MTLabeledBGRImgToBatch(Transformer):
-    """Threaded decode+batch: the reference spreads per-image transform
-    work over Engine.coreNumber() threads with per-thread transformer
-    clones (dataset/image/MTLabeledBGRImgToBatch.scala:52-80); here a
-    bounded prefetcher overlaps the same work with device steps."""
+    """Threaded decode+batch at a fixed output size: the reference spreads
+    per-image transform work over Engine.coreNumber() threads and sizes its
+    output buffers as width*height
+    (dataset/image/MTLabeledBGRImgToBatch.scala:52-80); here the size is
+    enforced by _EnsureSize and a bounded prefetcher overlaps the host work
+    with device steps."""
 
     def __init__(self, width: int, height: int, batch_size: int,
                  transformer: Transformer, depth: int = 8):
         from bigdl_tpu.dataset.transformer import Prefetcher
-        self._chain = transformer >> _ImgToSample() >> \
-            SampleToBatch(batch_size) >> Prefetcher(depth)
+        self._chain = transformer >> _EnsureSize(width, height) >> \
+            _ImgToSample() >> SampleToBatch(batch_size) >> Prefetcher(depth)
 
     def __call__(self, it: Iterator) -> Iterator[MiniBatch]:
         return self._chain(it)

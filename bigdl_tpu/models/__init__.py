@@ -1,0 +1,12 @@
+"""models: the model zoo + per-model Train/Test CLIs + perf harnesses
+(ref spark/dl/.../models/, 3,441 LoC: lenet, vgg, resnet, inception, rnn,
+autoencoder + utils/{DistriOptimizerPerf,LocalOptimizerPerf}).
+"""
+from bigdl_tpu.models.lenet import LeNet5
+from bigdl_tpu.models.vgg import VggForCifar10, Vgg_16, Vgg_19
+from bigdl_tpu.models.resnet import ResNet
+from bigdl_tpu.models.inception import Inception_v1, Inception_v2
+from bigdl_tpu.models.alexnet import AlexNet
+from bigdl_tpu.models.rnn import SimpleRNN
+from bigdl_tpu.models.autoencoder import Autoencoder
+from bigdl_tpu.models.textclassifier import TextClassifier

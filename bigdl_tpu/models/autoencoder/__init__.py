@@ -1,0 +1,14 @@
+"""Autoencoder for MNIST (ref models/autoencoder/Autoencoder.scala):
+784 -> 32 -> 784 with ReLU hidden and sigmoid reconstruction."""
+from bigdl_tpu import nn
+
+
+def Autoencoder(class_num: int = 32) -> nn.Sequential:
+    row_n, col_n = 28, 28
+    return nn.Sequential(
+        nn.Reshape((row_n * col_n,)),
+        nn.Linear(row_n * col_n, class_num),
+        nn.ReLU(True),
+        nn.Linear(class_num, row_n * col_n),
+        nn.Sigmoid(),
+    )

@@ -1,0 +1,34 @@
+"""LeNet-5 MNIST evaluation CLI (ref models/lenet/Test.scala)."""
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Evaluate LeNet-5 on MNIST")
+    p.add_argument("-f", "--folder", default="./")
+    p.add_argument("--model", required=True, help="trained model file")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--synthetic", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, image, mnist
+    from bigdl_tpu.optim import LocalValidator, Top1Accuracy
+
+    Engine.init()
+    records = mnist.synthetic(512, seed=9) if args.synthetic else \
+        mnist.load(args.folder, train=False)
+    mean, std = (60.0, 80.0) if args.synthetic else (mnist.TEST_MEAN, mnist.TEST_STD)
+    ds = DataSet.array(records) >> (
+        image.BytesToGreyImg(28, 28) >> image.GreyImgNormalizer(mean, std)
+        >> image.GreyImgToBatch(args.batchSize))
+    model = nn.Module.load(args.model)
+    for method, result in LocalValidator(model, ds).test([Top1Accuracy()]):
+        print(f"{method} is {result}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""LeNet-5 MNIST training CLI (ref models/lenet/Train.scala:42-97).
+
+    python -m bigdl_tpu.models.lenet.train -f /path/to/mnist -b 128 -e 10
+    python -m bigdl_tpu.models.lenet.train --synthetic  # no data needed
+
+Flags mirror the reference's scopt options (folder, checkpoint, model
+snapshot, state snapshot, batch size, max epoch, learning rate).
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Train LeNet-5 on MNIST")
+    p.add_argument("-f", "--folder", default="./", help="MNIST data dir")
+    p.add_argument("--checkpoint", default=None, help="checkpoint dir")
+    p.add_argument("--model", default=None, help="model snapshot to resume")
+    p.add_argument("--state", default=None, help="state snapshot to resume")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("-e", "--maxEpoch", type=int, default=10)
+    p.add_argument("-r", "--learningRate", type=float, default=0.05)
+    p.add_argument("--distributed", action="store_true",
+                   help="train data-parallel over the device mesh")
+    p.add_argument("--synthetic", action="store_true",
+                   help="use synthetic data (no MNIST files needed)")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, image, mnist
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.optim import (Optimizer, SGD, Top1Accuracy, Trigger)
+
+    Engine.init()
+    if args.synthetic:
+        train_records, test_records = mnist.synthetic(4096), mnist.synthetic(512, seed=9)
+        mean, std = 60.0, 80.0
+    else:
+        train_records = mnist.load(args.folder, train=True)
+        test_records = mnist.load(args.folder, train=False)
+        mean, std = mnist.TRAIN_MEAN, mnist.TRAIN_STD
+
+    pipeline = (image.BytesToGreyImg(28, 28)
+                >> image.GreyImgNormalizer(mean, std)
+                >> image.GreyImgToBatch(args.batchSize))
+    train_ds = DataSet.array(train_records, distributed=args.distributed) >> pipeline
+    val_ds = DataSet.array(test_records) >> pipeline
+
+    if args.model:
+        model = nn.Module.load(args.model)
+    else:
+        model = LeNet5(10).build(seed=1)
+    optimizer = Optimizer.create(model, train_ds, nn.ClassNLLCriterion())
+    method = SGD(learning_rate=args.learningRate)
+    if args.state:  # resume driver + optimizer state (ref Train.scala:55-68)
+        from bigdl_tpu.utils import file_io
+        snap = file_io.load(args.state)
+        optimizer.set_state(snap["driver_state"])
+        if snap.get("optim_state") is not None:
+            method._state = snap["optim_state"]
+    optimizer.set_optim_method(method) \
+             .set_end_when(Trigger.max_epoch(args.maxEpoch)) \
+             .set_validation(Trigger.every_epoch(), val_ds, [Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""ResNet (ref models/resnet/ResNet.scala:58-230): basicBlock/bottleneck
+with shortcut types A (identity + zero-pad), B (1x1 conv projection on
+dimension change), C (projection everywhere), for CIFAR-10 and ImageNet.
+
+DAG structure is expressed as ConcatTable + CAddTable exactly like the
+reference (there is no Graph module in v0.1; ResNet.scala:142-205).
+"""
+from __future__ import annotations
+
+from bigdl_tpu import nn
+
+
+def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str) -> nn.Module:
+    use_conv = shortcut_type == "C" or (shortcut_type == "B" and n_in != n_out)
+    if use_conv:
+        return nn.Sequential(
+            nn.SpatialConvolution(n_in, n_out, 1, 1, stride, stride),
+            nn.SpatialBatchNormalization(n_out),
+        )
+    if n_in != n_out:  # type A: strided identity + zero-pad channels
+        return nn.Sequential(
+            nn.SpatialAveragePooling(1, 1, stride, stride),
+            nn.Concat(2, nn.Identity(), nn.MulConstant(0.0)),
+        )
+    return nn.Identity()
+
+
+def _basic_block(n_in: int, n_out: int, stride: int, shortcut_type: str) -> nn.Module:
+    main = nn.Sequential(
+        nn.SpatialConvolution(n_in, n_out, 3, 3, stride, stride, 1, 1),
+        nn.SpatialBatchNormalization(n_out),
+        nn.ReLU(True),
+        nn.SpatialConvolution(n_out, n_out, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(n_out),
+    )
+    return nn.Sequential(
+        nn.ConcatTable(main, _shortcut(n_in, n_out, stride, shortcut_type)),
+        nn.CAddTable(True),
+        nn.ReLU(True),
+    )
+
+
+def _bottleneck(n_in: int, n_mid: int, stride: int, shortcut_type: str) -> nn.Module:
+    n_out = n_mid * 4
+    main = nn.Sequential(
+        nn.SpatialConvolution(n_in, n_mid, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(n_mid),
+        nn.ReLU(True),
+        nn.SpatialConvolution(n_mid, n_mid, 3, 3, stride, stride, 1, 1),
+        nn.SpatialBatchNormalization(n_mid),
+        nn.ReLU(True),
+        nn.SpatialConvolution(n_mid, n_out, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(n_out),
+    )
+    return nn.Sequential(
+        nn.ConcatTable(main, _shortcut(n_in, n_out, stride, shortcut_type)),
+        nn.CAddTable(True),
+        nn.ReLU(True),
+    )
+
+
+def ResNet(class_num: int = 1000, depth: int = 50, shortcut_type: str = "B",
+           dataset: str = "imagenet") -> nn.Sequential:
+    """ResNet factory (ref ResNet.scala apply): ``dataset`` is 'imagenet'
+    (7x7 stem, bottleneck for depth>=50) or 'cifar10' (3x3 stem,
+    basic blocks, depth = 6n+2)."""
+    model = nn.Sequential()
+    if dataset == "imagenet":
+        cfgs = {18: ([2, 2, 2, 2], 512, _basic_block),
+                34: ([3, 4, 6, 3], 512, _basic_block),
+                50: ([3, 4, 6, 3], 2048, _bottleneck),
+                101: ([3, 4, 23, 3], 2048, _bottleneck),
+                152: ([3, 8, 36, 3], 2048, _bottleneck)}
+        if depth not in cfgs:
+            raise ValueError(f"unsupported imagenet depth {depth}")
+        blocks, n_features, block = cfgs[depth]
+        model.add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3))
+        model.add(nn.SpatialBatchNormalization(64))
+        model.add(nn.ReLU(True))
+        model.add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+        widths = [64, 128, 256, 512]
+        n_in = 64
+        for i, (n_blocks, width) in enumerate(zip(blocks, widths)):
+            for j in range(n_blocks):
+                stride = 2 if (i > 0 and j == 0) else 1
+                model.add(block(n_in, width, stride, shortcut_type))
+                n_in = width * 4 if block is _bottleneck else width
+        model.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+        model.add(nn.View(n_features))
+        model.add(nn.Linear(n_features, class_num))
+        model.add(nn.LogSoftMax())
+    elif dataset == "cifar10":
+        if (depth - 2) % 6 != 0:
+            raise ValueError("cifar10 resnet depth must be 6n+2")
+        n = (depth - 2) // 6
+        model.add(nn.SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1))
+        model.add(nn.SpatialBatchNormalization(16))
+        model.add(nn.ReLU(True))
+        n_in = 16
+        for width, first_stride in ((16, 1), (32, 2), (64, 2)):
+            for j in range(n):
+                model.add(_basic_block(n_in, width, first_stride if j == 0 else 1,
+                                       shortcut_type))
+                n_in = width
+        model.add(nn.SpatialAveragePooling(8, 8, 1, 1))
+        model.add(nn.View(64))
+        model.add(nn.Linear(64, class_num))
+        model.add(nn.LogSoftMax())
+    else:
+        raise ValueError(f"unknown dataset {dataset!r}")
+    return model

@@ -1,0 +1,29 @@
+"""SimpleRNN char/word-level language model
+(ref models/rnn/SimpleRNN.scala:22): Recurrent(RnnCell) over one-hot
+inputs, time-distributed linear + log-softmax head.
+"""
+from bigdl_tpu import nn
+
+
+def SimpleRNN(input_size: int = 4000, hidden_size: int = 40,
+              output_size: int = 4000) -> nn.Sequential:
+    return nn.Sequential(
+        nn.Recurrent(nn.RnnCell(input_size, hidden_size, nn.Tanh())),
+        nn.TimeDistributed(nn.Sequential(
+            nn.Linear(hidden_size, output_size),
+            nn.LogSoftMax(),
+        )),
+    )
+
+
+def LstmLM(input_size: int = 4000, hidden_size: int = 128,
+           output_size: int = 4000) -> nn.Sequential:
+    """LSTM variant of the language model (the reference's rnn example can
+    swap RnnCell for LSTM; config #5's 'Char-RNN / LSTM')."""
+    return nn.Sequential(
+        nn.Recurrent(nn.LSTM(input_size, hidden_size)),
+        nn.TimeDistributed(nn.Sequential(
+            nn.Linear(hidden_size, output_size),
+            nn.LogSoftMax(),
+        )),
+    )

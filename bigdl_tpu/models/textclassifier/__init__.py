@@ -1,0 +1,50 @@
+"""Text classifier (ref example/textclassification/TextClassifier.scala:
+122-176: GloVe embeddings + convolutional classifier; plus the LSTM
+variant named by the benchmark configs).
+
+``TextClassifier`` consumes (batch, seq_len, embed_dim) pre-embedded
+sequences like the reference (embeddings are applied in the data pipeline
+there); ``TextClassifierWithEmbedding`` starts from 1-based token ids via
+LookupTable.
+"""
+from bigdl_tpu import nn
+
+
+def TextClassifier(class_num: int = 20, embed_dim: int = 100,
+                   seq_len: int = 500, encoder: str = "cnn",
+                   hidden: int = 128) -> nn.Sequential:
+    if encoder == "cnn":
+        # treat the sequence as a 1 x seq_len x embed_dim image, like the
+        # reference's SpatialConvolution over (1, seq, embed)
+        return nn.Sequential(
+            nn.Reshape((1, seq_len, embed_dim)),
+            nn.SpatialConvolution(1, 128, embed_dim, 5),
+            nn.ReLU(True),
+            nn.SpatialMaxPooling(1, 5, 1, 5),
+            nn.SpatialConvolution(128, 128, 1, 5),
+            nn.ReLU(True),
+            nn.SpatialMaxPooling(1, 5, 1, 5),
+            nn.Reshape((128 * ((((seq_len - 4) // 5) - 4) // 5),)),
+            nn.Linear(128 * ((((seq_len - 4) // 5) - 4) // 5), 100),
+            nn.Linear(100, class_num),
+            nn.LogSoftMax(),
+        )
+    if encoder == "lstm":
+        return nn.Sequential(
+            nn.Recurrent(nn.LSTM(embed_dim, hidden)),
+            nn.Select(2, -1),  # last timestep
+            nn.Linear(hidden, class_num),
+            nn.LogSoftMax(),
+        )
+    raise ValueError(f"unknown encoder {encoder!r}")
+
+
+def TextClassifierWithEmbedding(vocab_size: int, class_num: int = 20,
+                                embed_dim: int = 100, hidden: int = 128) -> nn.Sequential:
+    return nn.Sequential(
+        nn.LookupTable(vocab_size, embed_dim),
+        nn.Recurrent(nn.LSTM(embed_dim, hidden)),
+        nn.Select(2, -1),
+        nn.Linear(hidden, class_num),
+        nn.LogSoftMax(),
+    )

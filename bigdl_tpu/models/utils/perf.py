@@ -1,0 +1,114 @@
+"""Synthetic-data throughput benchmark (ref models/utils/
+DistriOptimizerPerf.scala:32-90 and LocalOptimizerPerf.scala — the
+reference repo's only benchmark suite).
+
+    python -m bigdl_tpu.models.utils.perf -m inception_v1 -b 32 -i 20
+    python -m bigdl_tpu.models.utils.perf -m resnet50 --distributed
+
+Prints per-iteration and steady-state records/s.
+"""
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+MODELS = {
+    "lenet5": ("mnist", 28),
+    "alexnet": ("imagenet", 227),
+    "inception_v1": ("imagenet", 224),
+    "inception_v2": ("imagenet", 224),
+    "vgg16": ("imagenet", 224),
+    "vgg19": ("imagenet", 224),
+    "resnet50": ("imagenet", 224),
+    "vgg_cifar": ("cifar", 32),
+}
+
+
+def build_model(name: str):
+    from bigdl_tpu import models
+    if name == "lenet5":
+        return models.LeNet5(10)
+    if name == "alexnet":
+        return models.AlexNet(1000)
+    if name == "inception_v1":
+        return models.Inception_v1(1000)
+    if name == "inception_v2":
+        return models.Inception_v2(1000)
+    if name == "vgg16":
+        return models.Vgg_16(1000)
+    if name == "vgg19":
+        return models.Vgg_19(1000)
+    if name == "resnet50":
+        return models.ResNet(1000, depth=50, dataset="imagenet")
+    if name == "vgg_cifar":
+        return models.VggForCifar10(10)
+    raise ValueError(f"unknown model {name}; choose from {sorted(MODELS)}")
+
+
+def run_perf(model_name: str, batch_size: int, iterations: int,
+             distributed: bool = False, data_type: str = "random",
+             warmup: int = 3, dtype="float32") -> dict:
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import SGD, Trigger, LocalOptimizer
+    from bigdl_tpu.parallel import DistriOptimizer
+
+    kind, size = MODELS[model_name]
+    rng = np.random.RandomState(0)
+    n_classes = 10 if kind in ("mnist", "cifar") else 1000
+    channels = 1 if kind == "mnist" else 3
+    shape = (channels, size, size) if model_name != "lenet5" else (1, 28, 28)
+
+    def gen():
+        if data_type == "constant":
+            return np.ones(shape, np.float32)
+        return rng.randn(*shape).astype(np.float32)
+
+    samples = [Sample(gen(), np.asarray(float(i % n_classes) + 1, dtype=np.float32))
+               for i in range(batch_size * 2)]
+    ds = DataSet.array(samples) >> SampleToBatch(batch_size, drop_last=True)
+    model = build_model(model_name).build(seed=1)
+    cls = DistriOptimizer if distributed else LocalOptimizer
+    opt = cls(model, ds, nn.ClassNLLCriterion())
+    opt.set_optim_method(SGD(learning_rate=0.01)) \
+       .set_end_when(Trigger.max_iteration(warmup + iterations))
+
+    times: list[float] = []
+    orig_add = opt.metrics.add
+
+    def capture(name, value):
+        if name == "computing time":
+            times.append(value)
+        orig_add(name, value)
+    opt.metrics.add = capture
+
+    opt.optimize()
+    steady = times[warmup:]
+    throughput = batch_size / (sum(steady) / len(steady))
+    return {"model": model_name, "batch_size": batch_size,
+            "iterations": iterations, "throughput_rec_s": throughput,
+            "mean_step_s": sum(steady) / len(steady)}
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Synthetic throughput benchmark")
+    p.add_argument("-m", "--model", default="inception_v1", choices=sorted(MODELS))
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("-i", "--iteration", type=int, default=20)
+    p.add_argument("-t", "--dataType", default="random", choices=["random", "constant"])
+    p.add_argument("--distributed", action="store_true")
+    args = p.parse_args(argv)
+    result = run_perf(args.model, args.batchSize, args.iteration,
+                      distributed=args.distributed, data_type=args.dataType)
+    print(f"{result['model']}: {result['throughput_rec_s']:.1f} records/s "
+          f"({result['mean_step_s']*1000:.1f} ms/step, batch {result['batch_size']})")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,71 @@
+"""VGG family (ref models/vgg/VggForCifar10.scala and the Vgg_16/Vgg_19
+factories in models/utils perf harness + example/loadmodel).
+"""
+from bigdl_tpu import nn
+
+
+def _conv_bn_relu(n_in: int, n_out: int) -> list:
+    return [
+        nn.SpatialConvolution(n_in, n_out, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(n_out, eps=1e-3),
+        nn.ReLU(True),
+    ]
+
+
+def VggForCifar10(class_num: int = 10) -> nn.Sequential:
+    """VGG-16-style net with BN for 3x32x32 CIFAR images
+    (ref models/vgg/VggForCifar10.scala)."""
+    cfg = [(3, 64), (64, 64), "M", (64, 128), (128, 128), "M",
+           (128, 256), (256, 256), (256, 256), "M",
+           (256, 512), (512, 512), (512, 512), "M",
+           (512, 512), (512, 512), (512, 512), "M"]
+    layers: list = []
+    for item in cfg:
+        if item == "M":
+            layers.append(nn.SpatialMaxPooling(2, 2, 2, 2).ceil())
+        else:
+            layers.extend(_conv_bn_relu(*item))
+    model = nn.Sequential(*layers)
+    model.add(nn.View(512))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(512, 512))
+    model.add(nn.BatchNormalization(512))
+    model.add(nn.ReLU(True))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(512, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def _vgg_plain(cfg: list, class_num: int) -> nn.Sequential:
+    layers: list = []
+    n_in = 3
+    for item in cfg:
+        if item == "M":
+            layers.append(nn.SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            layers.append(nn.SpatialConvolution(n_in, item, 3, 3, 1, 1, 1, 1))
+            layers.append(nn.ReLU(True))
+            n_in = item
+    model = nn.Sequential(*layers)
+    model.add(nn.View(512 * 7 * 7))
+    model.add(nn.Linear(512 * 7 * 7, 4096))
+    model.add(nn.Threshold(0, 1e-6))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, 4096))
+    model.add(nn.Threshold(0, 1e-6))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Linear(4096, class_num))
+    model.add(nn.LogSoftMax())
+    return model
+
+
+def Vgg_16(class_num: int = 1000) -> nn.Sequential:
+    """VGG-16 for 3x224x224 ImageNet (ref models/utils perf harness vgg16)."""
+    return _vgg_plain([64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                       512, 512, 512, "M", 512, 512, 512, "M"], class_num)
+
+
+def Vgg_19(class_num: int = 1000) -> nn.Sequential:
+    return _vgg_plain([64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+                       512, 512, 512, 512, "M", 512, 512, 512, 512, "M"], class_num)

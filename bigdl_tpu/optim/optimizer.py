@@ -110,15 +110,18 @@ class Optimizer:
     def _maybe_validate(self):
         if (self.validation_trigger is not None and self.validation_dataset is not None
                 and self.validation_trigger(self.state)):
-            results = self._validate()
-            for method, result in results:
-                log.info("%s is %s", method, result)
-                if self.validation_summary is not None:
-                    value = result.result()[0]
-                    self.validation_summary.add_scalar(
-                        str(method), value, self.state["neval"] - 1)
-            return results
+            return self._run_validation()
         return None
+
+    def _run_validation(self):
+        results = self._validate()
+        for method, result in results:
+            log.info("%s is %s", method, result)
+            if self.validation_summary is not None:
+                value = result.result()[0]
+                self.validation_summary.add_scalar(
+                    str(method), value, self.state["neval"] - 1)
+        return results
 
     def _validate(self):
         raise NotImplementedError
@@ -212,8 +215,11 @@ class LocalOptimizer(Optimizer):
                 self.state["epoch"] += 1
                 self.state["epoch_finished"] = True
                 records_this_epoch = 0
+                # reshuffle WITHOUT rebinding the iterator: the infinite
+                # train iterator picks up the new permutation on its next
+                # pass, and any Prefetcher threads in the chain stay live
+                # (rebinding would leak one blocked worker per epoch)
                 self.dataset.shuffle()
-                data_iter = self.dataset.data(train=True)
             # publish params so validation/checkpoint see current weights
             self.model.params, self.model.buffers = params, buffers
             self.optim_method._state = opt_state
@@ -247,12 +253,20 @@ class LocalOptimizer(Optimizer):
             return float(v), g
 
         flat = flat0
+        dataset_size = self.dataset.size()
+        records_this_epoch = 0
+        batch_records = int(batch.data.shape[0])
         while not self.end_when(self.state):
             self.state["epoch_finished"] = False
             flat, hist = self.optim_method.optimize(feval, flat)
             self.state["loss"] = hist[-1]
             log.info("LBFGS iteration %d: loss %.6f", self.state["neval"], hist[-1])
             self.state["neval"] += 1
+            records_this_epoch += batch_records
+            if records_this_epoch >= dataset_size:
+                self.state["epoch"] += 1
+                self.state["epoch_finished"] = True
+                records_this_epoch = 0
             model.params = unravel(flat)
             self._maybe_validate()
             self._maybe_checkpoint()

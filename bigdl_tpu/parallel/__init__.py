@@ -1,0 +1,16 @@
+"""parallel: distributed engine (ref parameters/ + spark-version/ +
+optim/DistriOptimizer.scala).
+
+The reference's communication backend is a hand-rolled FP16 all-reduce over
+Spark's BlockManager (reduce-scatter + slice-owner update + all-gather,
+parameters/AllReduceParameter.scala:99-228).  Here the same cycle is XLA
+collectives over ICI/DCN inside one ``jax.shard_map``-ped train step:
+bf16 ``psum_scatter`` gradients, ZeRO-1-style sharded optimizer update on
+each device's slice, bf16 ``all_gather`` of updated weights.
+"""
+from bigdl_tpu.parallel.mesh import (
+    create_mesh, data_parallel_mesh, DATA_AXIS, MODEL_AXIS, SEQUENCE_AXIS,
+    PIPELINE_AXIS, EXPERT_AXIS,
+)
+from bigdl_tpu.parallel.parameters import AllReduceParameter, CompressedTensor
+from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer, DistriValidator

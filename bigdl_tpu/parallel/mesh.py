@@ -1,0 +1,61 @@
+"""Device mesh construction (ref utils/Engine.scala topology discovery:
+one executor = one node, N cores = N replicas becomes one process = one
+host, N chips = N mesh slots).
+
+Axis names are fixed strings so layers/optimizers agree on them:
+  data     - batch sharding (the reference's only strategy)
+  model    - tensor parallelism (width sharding)
+  sequence - sequence/context parallelism (ring attention)
+  pipeline - pipeline stages
+  expert   - mixture-of-experts
+A mesh can use any subset; data-parallel-only meshes are 1-D.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQUENCE_AXIS = "sequence"
+PIPELINE_AXIS = "pipeline"
+EXPERT_AXIS = "expert"
+
+
+def create_mesh(axes: Optional[dict[str, int]] = None,
+                devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh from {axis_name: size}.  With no axes: all devices on
+    the data axis.  Sizes must multiply to the device count (one axis may
+    be -1 to absorb the remainder)."""
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if not axes:
+        axes = {DATA_AXIS: n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh axes {dict(zip(names, sizes))} != {n} devices")
+    dev_array = np.asarray(devices).reshape(sizes)
+    return Mesh(dev_array, axis_names=names)
+
+
+def data_parallel_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return create_mesh({DATA_AXIS: len(devs)}, devices=devs)
+
+
+def batch_sharding(mesh: Mesh, ndim: int, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard dim 0 (batch) over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, PartitionSpec(axis, *([None] * (ndim - 1))))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

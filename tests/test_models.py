@@ -1,0 +1,140 @@
+"""Model zoo shape/forward tests (ref models/*Spec).  Full-size ImageNet
+models run a single tiny-batch forward to validate wiring."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import (
+    AlexNet, Autoencoder, Inception_v1, Inception_v2, LeNet5, ResNet,
+    SimpleRNN, TextClassifier, Vgg_16, VggForCifar10,
+)
+
+
+def _forward(model, shape, seed=0):
+    model.build(seed=seed)
+    x = jnp.asarray(np.random.RandomState(0).randn(*shape).astype(np.float32))
+    return model.forward(x)
+
+
+class TestLeNet:
+    def test_forward_and_count(self):
+        m = LeNet5(10)
+        y = _forward(m, (2, 1, 28, 28))
+        assert y.shape == (2, 10)
+        flat, _, _ = m.get_parameters()
+        # conv1 6*(25+... ) known total for LeNet5 with 100-unit fc
+        assert flat.size == (6 * 25 + 6) + (12 * 6 * 25 + 12) + \
+            (100 * 192 + 100) + (10 * 100 + 10)
+
+    def test_log_probs(self):
+        y = _forward(LeNet5(10), (2, 1, 28, 28))
+        np.testing.assert_allclose(np.asarray(jnp.exp(y).sum(-1)), 1.0, rtol=1e-4)
+
+
+class TestResNet:
+    def test_cifar_resnet20(self):
+        y = _forward(ResNet(10, depth=20, dataset="cifar10", shortcut_type="A"),
+                     (2, 3, 32, 32))
+        assert y.shape == (2, 10)
+
+    def test_imagenet_resnet18(self):
+        y = _forward(ResNet(1000, depth=18, dataset="imagenet"), (1, 3, 224, 224))
+        assert y.shape == (1, 1000)
+
+    def test_imagenet_resnet50(self):
+        m = ResNet(1000, depth=50, dataset="imagenet")
+        y = _forward(m, (1, 3, 224, 224))
+        assert y.shape == (1, 1000)
+        flat, _, _ = m.get_parameters()
+        assert 25.5e6 < flat.size < 25.6e6  # ~25.557M params
+
+    def test_bad_depth(self):
+        with pytest.raises(ValueError):
+            ResNet(10, depth=19, dataset="cifar10")
+
+
+class TestVgg:
+    def test_cifar_vgg(self):
+        y = _forward(VggForCifar10(10), (2, 3, 32, 32))
+        assert y.shape == (2, 10)
+
+    def test_vgg16_imagenet(self):
+        y = _forward(Vgg_16(1000), (1, 3, 224, 224))
+        assert y.shape == (1, 1000)
+
+
+class TestInception:
+    def test_v1(self):
+        y = _forward(Inception_v1(1000), (1, 3, 224, 224))
+        assert y.shape == (1, 1000)
+
+    def test_v2(self):
+        y = _forward(Inception_v2(1000), (1, 3, 224, 224))
+        assert y.shape == (1, 1000)
+
+
+class TestAlexNet:
+    def test_forward(self):
+        y = _forward(AlexNet(1000), (1, 3, 227, 227))
+        assert y.shape == (1, 1000)
+
+
+class TestRnnModels:
+    def test_simple_rnn(self):
+        m = SimpleRNN(input_size=50, hidden_size=16, output_size=50)
+        y = _forward(m, (2, 7, 50))
+        assert y.shape == (2, 7, 50)
+
+    def test_text_classifier_lstm(self):
+        m = TextClassifier(class_num=5, embed_dim=20, encoder="lstm", hidden=16)
+        y = _forward(m, (3, 11, 20))
+        assert y.shape == (3, 5)
+
+    def test_text_classifier_cnn(self):
+        m = TextClassifier(class_num=5, embed_dim=20, seq_len=100, encoder="cnn")
+        y = _forward(m, (2, 100, 20))
+        assert y.shape == (2, 5)
+
+
+class TestAutoencoder:
+    def test_reconstruction_shape(self):
+        y = _forward(Autoencoder(32), (4, 1, 28, 28))
+        assert y.shape == (4, 784)
+
+    def test_trains(self):
+        from bigdl_tpu.dataset import DataSet, Sample, image, mnist
+        from bigdl_tpu.dataset.transformer import SampleToBatch
+        from bigdl_tpu.optim import SGD, Trigger, LocalOptimizer
+        # structured (learnable) images: synthetic MNIST scaled to [0,1]
+        recs = mnist.synthetic(32)
+        to_img = image.BytesToGreyImg(28, 28)
+        samples = []
+        for r in recs:
+            im = to_img.transform_one(r).data / 255.0
+            samples.append(Sample(im, im.reshape(-1)))
+        ds = DataSet.array(samples) >> SampleToBatch(16, drop_last=True)
+        model = Autoencoder(32)
+        opt = LocalOptimizer(model, ds, nn.MSECriterion())
+        opt.set_optim_method(SGD(learning_rate=4.0, momentum=0.9, dampening=0.0)) \
+           .set_end_when(Trigger.max_iteration(150))
+        opt.optimize()
+        # pixel-variance (predict-the-mean) floor is ~0.036; beating it by
+        # 2x proves the bottleneck learned structure
+        assert opt.state["loss"] < 0.02
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import sys
+        sys.path.insert(0, "/root/repo")
+        import __graft_entry__
+        fn, args = __graft_entry__.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (8, 1000)
+
+    def test_dryrun_multichip(self):
+        import __graft_entry__
+        __graft_entry__.dryrun_multichip(8)

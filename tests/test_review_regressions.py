@@ -128,3 +128,67 @@ def test_label_padding_is_valid_class():
     assert s.label.tolist() == [2.0, 3.0, 3.0, 3.0, 3.0, 3.0]
     with pytest.raises(ValueError):
         LabeledSentenceToSample(5, pad_label=0.0)
+
+
+def test_lbfgs_epoch_accounting_terminates():
+    import numpy as np
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import LBFGS, Trigger, LocalOptimizer
+    rng = np.random.RandomState(0)
+    samples = [Sample(rng.randn(2).astype(np.float32), rng.randn(2).astype(np.float32))
+               for _ in range(8)]
+    ds = DataSet.array(samples) >> SampleToBatch(8)
+    opt = LocalOptimizer(nn.Linear(2, 2), ds, nn.MSECriterion())
+    opt.set_optim_method(LBFGS(max_iter=2)).set_end_when(Trigger.max_epoch(2))
+    opt.optimize()
+    assert opt.state["epoch"] == 3  # terminated after 2 epochs
+
+
+def test_epoch_rollover_keeps_iterator_and_reshuffles():
+    import numpy as np
+    from bigdl_tpu.dataset import DataSet
+    ds = DataSet.array(list(range(10)))
+    it = ds.data(train=True)
+    first = [next(it) for _ in range(10)]
+    ds.shuffle()  # as the optimizer does at rollover — same iterator object
+    second = [next(it) for _ in range(10)]
+    assert sorted(second) == list(range(10))
+    assert first != second  # new permutation picked up without rebinding
+
+
+def test_mt_batch_enforces_size():
+    import numpy as np
+    from bigdl_tpu.dataset import image
+    from bigdl_tpu.dataset.types import LabeledImage
+    imgs = [LabeledImage(np.random.rand(3, s, s).astype(np.float32), 1.0)
+            for s in (40, 20, 32)]
+    tr = image.MTLabeledBGRImgToBatch(32, 32, 3, image.HFlip(0.0))
+    (batch,) = list(tr(iter(imgs)))
+    assert batch.data.shape == (3, 3, 32, 32)
+
+
+def test_stateful_trigger_polled_once_per_iteration():
+    import numpy as np
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.parallel import DistriOptimizer
+
+    calls = []
+
+    def latch(state):
+        calls.append(state["neval"])
+        return False
+
+    rng = np.random.RandomState(0)
+    samples = [Sample(rng.randn(4).astype(np.float32), np.asarray(1.0, np.float32))
+               for _ in range(16)]
+    ds = DataSet.array(samples) >> SampleToBatch(8, drop_last=True)
+    m = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax())
+    opt = DistriOptimizer(m, ds, nn.ClassNLLCriterion())
+    opt.set_optim_method(SGD(learning_rate=0.1)) \
+       .set_end_when(Trigger.max_iteration(3)) \
+       .set_validation(Trigger(latch), ds, [])
+    opt.optimize()
+    assert calls == sorted(set(calls))  # each neval polled exactly once
