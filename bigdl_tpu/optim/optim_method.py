@@ -206,8 +206,11 @@ class Adagrad(OptimMethod):
         return {"iteration": jnp.zeros((), jnp.int32),
                 "accum": jax.tree_util.tree_map(jnp.zeros_like, params)}
 
+    def current_rate(self, state, epoch=1):
+        return self.learning_rate / (1.0 + state["iteration"] * self.learning_rate_decay)
+
     def update(self, grads, state, params, epoch=1):
-        lr = self.learning_rate / (1.0 + state["iteration"] * self.learning_rate_decay)
+        lr = self.current_rate(state, epoch)
         accum = jax.tree_util.tree_map(lambda a, g: a + g * g, state["accum"], grads)
         new_params = jax.tree_util.tree_map(
             lambda w, g, a: w - lr * g / (jnp.sqrt(a) + self.eps), params, grads, accum)

@@ -107,6 +107,47 @@ class Optimizer:
         self.state.setdefault("records_processed", 0)
         self.state["epoch_finished"] = False
 
+    def _record_train_summary(self, loss_val: float, throughput: float,
+                              epoch: Optional[int] = None,
+                              iteration: Optional[int] = None,
+                              record_params: Optional[bool] = None):
+        """Write trigger-gated scalars (+ optional Parameters histograms) —
+        ref DistriOptimizer.scala:358-388 / utils/Summary.scala:121-146.
+        Plain summaries (no triggers attr) get Loss/Throughput every step.
+        Callers must publish current weights to self.model.params first.
+        ``epoch``/``iteration`` identify the step that actually ran (driver
+        state may have rolled over; opt-state iteration may differ from
+        neval after a resume).  ``record_params`` lets the caller poll the
+        Parameters trigger itself (it must be polled exactly once)."""
+        ts = self.train_summary
+        if ts is None:
+            return
+        step = self.state["neval"]
+        if epoch is None:
+            epoch = self.state["epoch"]
+        if iteration is None:
+            iteration = step - 1
+        gated = hasattr(ts, "should_record")
+        if not gated or ts.should_record("Loss", self.state):
+            ts.add_scalar("Loss", loss_val, step)
+        if not gated or ts.should_record("Throughput", self.state):
+            ts.add_scalar("Throughput", throughput, step)
+        if gated and ts.should_record("LearningRate", self.state):
+            m = self.optim_method
+            if hasattr(m, "current_rate"):
+                lr = float(m.current_rate({"iteration": iteration}, epoch))
+            else:
+                lr = float(getattr(m, "learning_rate", 0.0))
+            ts.add_scalar("LearningRate", lr, step)
+        if record_params is None:
+            record_params = gated and ts.should_record("Parameters", self.state)
+        if record_params:
+            flat = jax.tree_util.tree_flatten_with_path(self.model.params)[0]
+            for path, leaf in flat:
+                name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                                for p in path)
+                ts.add_histogram(name, jax.device_get(leaf), step)
+
     def _maybe_validate(self):
         if (self.validation_trigger is not None and self.validation_dataset is not None
                 and self.validation_trigger(self.state)):
@@ -207,10 +248,7 @@ class LocalOptimizer(Optimizer):
             self.state["throughput"] = bs / dt
             log.info("Epoch %d iteration %d: loss %.6f, throughput %.1f records/s",
                      self.state["epoch"], self.state["neval"], loss_val, bs / dt)
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss_val, self.state["neval"])
-                self.train_summary.add_scalar("Throughput", bs / dt, self.state["neval"])
-            self.state["neval"] += 1
+            epoch_of_step = self.state["epoch"]
             if records_this_epoch >= dataset_size:  # epoch rollover
                 self.state["epoch"] += 1
                 self.state["epoch_finished"] = True
@@ -220,9 +258,18 @@ class LocalOptimizer(Optimizer):
                 # pass, and any Prefetcher threads in the chain stay live
                 # (rebinding would leak one blocked worker per epoch)
                 self.dataset.shuffle()
-            # publish params so validation/checkpoint see current weights
+            # publish params so summaries/validation/checkpoint see current
+            # weights (and never the buffers donated into the next step)
             self.model.params, self.model.buffers = params, buffers
             self.optim_method._state = opt_state
+            # the step already advanced opt_state's counter, so the lr it
+            # used corresponds to iteration-1
+            it = (int(opt_state["iteration"]) - 1
+                  if isinstance(opt_state, dict) and "iteration" in opt_state
+                  else None)
+            self._record_train_summary(loss_val, bs / dt, epoch=epoch_of_step,
+                                       iteration=it)
+            self.state["neval"] += 1
             self._maybe_validate()
             self._maybe_checkpoint()
         self.state["records_processed"] = records_this_epoch

@@ -167,11 +167,7 @@ class DistriOptimizer(Optimizer):
             log.info("Epoch %d iteration %d: loss %.6f, throughput %.1f records/s",
                      self.state["epoch"], self.state["neval"], loss_val,
                      global_bs / dt)
-            if self.train_summary is not None:
-                self.train_summary.add_scalar("Loss", loss_val, self.state["neval"])
-                self.train_summary.add_scalar("Throughput", global_bs / dt,
-                                              self.state["neval"])
-            self.state["neval"] += 1
+            epoch_of_step = self.state["epoch"]
             if records_this_epoch >= global_dataset_size:
                 self.state["epoch"] += 1
                 self.state["epoch_finished"] = True
@@ -183,6 +179,34 @@ class DistriOptimizer(Optimizer):
             # triggers must not be polled twice), then publish gathered
             # weights for validation/checkpoint (the reference's getModel,
             # DistriOptimizer.scala:534-564)
+            published = False
+
+            def publish():
+                # expensive full gather to host — done only when a trigger
+                # fires, like the reference's "getting parameters from
+                # workers is a heavy operation" gate (getModel,
+                # DistriOptimizer.scala:534-564), and at most once/iteration
+                nonlocal published
+                if published:
+                    return
+                published = True
+                self.model.params = arp.to_pytree(np.asarray(w_shards))
+                self.model.buffers = buffers
+                self.optim_method._state = jax.tree_util.tree_map(
+                    np.asarray, opt_state)
+
+            ts = self.train_summary
+            do_param_hist = (ts is not None and hasattr(ts, "should_record")
+                             and ts.should_record("Parameters", self.state))
+            if do_param_hist:
+                publish()
+            it = (int(opt_state["iteration"]) - 1
+                  if isinstance(opt_state, dict) and "iteration" in opt_state
+                  else None)
+            self._record_train_summary(loss_val, global_bs / dt,
+                                       epoch=epoch_of_step, iteration=it,
+                                       record_params=do_param_hist)
+            self.state["neval"] += 1
             do_val = (self.validation_trigger is not None
                       and self.validation_dataset is not None
                       and self.validation_trigger(self.state))
@@ -190,9 +214,7 @@ class DistriOptimizer(Optimizer):
                        and self.checkpoint_path is not None
                        and self.checkpoint_trigger(self.state))
             if do_val or do_ckpt:
-                self.model.params = arp.to_pytree(np.asarray(w_shards))
-                self.model.buffers = buffers
-                self.optim_method._state = jax.tree_util.tree_map(np.asarray, opt_state)
+                publish()
                 if do_val:
                     self._run_validation()
                 if do_ckpt:

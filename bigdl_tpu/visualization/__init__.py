@@ -1,0 +1,16 @@
+"""TensorBoard-compatible visualization (ref ``spark/visualization/`` +
+``utils/Summary.scala``): tfevents writer/reader with masked-CRC32C record
+framing and a hand-rolled protobuf codec for the Event schema."""
+from .crc import crc32c, masked_crc32c
+from .proto import Event, HistogramProto, SummaryValue, decode_event
+from .record import RecordWriter, read_records
+from .reader import list_files, list_tags, read_scalar
+from .summary import Summary, TrainSummary, ValidationSummary, histogram, scalar
+from .writer import EventWriter, FileWriter
+
+__all__ = [
+    "crc32c", "masked_crc32c", "Event", "HistogramProto", "SummaryValue",
+    "decode_event", "RecordWriter", "read_records", "list_files",
+    "list_tags", "read_scalar", "Summary", "TrainSummary",
+    "ValidationSummary", "histogram", "scalar", "EventWriter", "FileWriter",
+]

@@ -1,0 +1,58 @@
+"""CRC32C (Castagnoli) + TensorFlow's masked CRC.
+
+TPU-native rebuild of the reference's hand-written CRC class
+(``spark/visualization/src/main/java/.../netty/Crc32c.java``) and the
+masking in ``visualization/tensorboard/RecordWriter.scala:45-55``: tfevents
+records are framed as ``len + masked_crc(len) + payload + masked_crc(payload)``
+where ``masked = ((crc >> 15 | crc << 17) + 0xa282ead8) & 0xffffffff``.
+
+A C++ implementation (``native/``) is used when built; this pure-python
+table-driven fallback is always available.
+"""
+from __future__ import annotations
+
+_POLY = 0x82F63B78  # reflected Castagnoli polynomial
+
+def _make_table():
+    table = []
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ _POLY if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+_TABLE = _make_table()
+
+
+def crc32c_py(data: bytes, crc: int = 0) -> int:
+    crc ^= 0xFFFFFFFF
+    for b in data:
+        crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _load_native():
+    try:
+        from bigdl_tpu.native import lib as _nl
+        return _nl.crc32c if _nl is not None and hasattr(_nl, "crc32c") else None
+    except Exception:
+        return None
+
+_native_crc = None
+_native_checked = False
+
+
+def crc32c(data: bytes, crc: int = 0) -> int:
+    global _native_crc, _native_checked
+    if not _native_checked:
+        _native_crc = _load_native()
+        _native_checked = True
+    if _native_crc is not None:
+        return _native_crc(data, crc)
+    return crc32c_py(data, crc)
+
+
+def masked_crc32c(data: bytes) -> int:
+    crc = crc32c(data)
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF

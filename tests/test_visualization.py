@@ -1,0 +1,147 @@
+"""tfevents writer/reader tests (ref visualization/ + utils/Summary.scala).
+
+The encoding is validated three ways: round-trip through our own decoder,
+byte-level CRC framing, and — when the tensorboard package is present —
+parsing our files with TensorFlow's own generated Event proto.
+"""
+import math
+import os
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.visualization import (Event, FileWriter, RecordWriter,
+                                     SummaryValue, TrainSummary,
+                                     ValidationSummary, crc32c, decode_event,
+                                     histogram, list_tags, masked_crc32c,
+                                     read_records, read_scalar, scalar)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 / iSCSI test vectors
+    assert crc32c(b"") == 0x0
+    assert crc32c(b"a") == 0xC1D04330
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"\xff" * 32) == 0x62A8AB43
+
+
+def test_masked_crc_matches_tf_masking():
+    crc = crc32c(b"123456789")
+    expected = (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+    assert masked_crc32c(b"123456789") == expected
+
+
+def test_record_roundtrip(tmp_path):
+    p = str(tmp_path / "rec")
+    w = RecordWriter(p)
+    payloads = [b"hello", b"", b"x" * 1000]
+    for pl in payloads:
+        w.write(pl)
+    w.close()
+    assert list(read_records(p)) == payloads
+
+
+def test_record_truncation_tolerated(tmp_path):
+    p = str(tmp_path / "rec")
+    w = RecordWriter(p)
+    w.write(b"complete")
+    w.close()
+    with open(p, "ab") as f:
+        f.write(b"\x99" * 7)  # partial header of a half-written record
+    assert list(read_records(p)) == [b"complete"]
+
+
+def test_event_proto_roundtrip():
+    ev = Event(wall_time=123.5, step=7,
+               values=[scalar("Loss", 0.25), scalar("Throughput", 1e4)])
+    dec = decode_event(ev.encode())
+    assert dec.step == 7 and abs(dec.wall_time - 123.5) < 1e-9
+    assert {v.tag: v.simple_value for v in dec.values} == \
+        {"Loss": 0.25, "Throughput": pytest.approx(1e4)}
+
+
+def test_event_proto_parses_with_tensorflow_proto():
+    event_pb2 = pytest.importorskip("tensorboard.compat.proto.event_pb2")
+    ev = Event(wall_time=9.75, step=42,
+               values=[scalar("acc", 0.5), histogram("w", [0.1, -0.2, 0.0])])
+    tf_ev = event_pb2.Event()
+    tf_ev.ParseFromString(ev.encode())
+    assert tf_ev.step == 42 and tf_ev.wall_time == 9.75
+    vals = {v.tag: v for v in tf_ev.summary.value}
+    assert vals["acc"].simple_value == 0.5
+    h = vals["w"].histo
+    assert h.num == 3 and h.min == -0.2 and h.max == pytest.approx(0.1)
+    assert sum(h.bucket) == 3
+
+
+def test_histogram_buckets():
+    v = histogram("h", np.array([0.0, 1e-13, 5.0, -3.0]))
+    h = v.histo
+    assert h.num == 4
+    assert h.sum == pytest.approx(2.0 + 1e-13)
+    assert sum(h.bucket) == 4
+    assert all(b >= 0 for b in h.bucket)
+    assert h.bucket_limit == sorted(h.bucket_limit)
+
+
+def test_filewriter_reader_roundtrip(tmp_path):
+    d = str(tmp_path / "logs")
+    w = FileWriter(d)
+    for step in range(5):
+        w.add_summary(scalar("Loss", 1.0 / (step + 1)), step)
+    w.close()
+    got = read_scalar(d, "Loss")
+    assert [s for s, _v, _t in got] == [0, 1, 2, 3, 4]
+    assert got[0][1] == pytest.approx(1.0)
+    assert got[4][1] == pytest.approx(0.2)
+    assert list_tags(d) == ["Loss"]
+
+
+def test_train_summary_triggers(tmp_path):
+    from bigdl_tpu.optim import Trigger
+    ts = TrainSummary(str(tmp_path), "app")
+    assert ts.get_summary_trigger("Loss") is not None
+    assert ts.get_summary_trigger("Parameters") is None
+    ts.set_summary_trigger("Parameters", Trigger.several_iteration(10))
+    assert ts.should_record("Parameters", {"neval": 10})
+    assert not ts.should_record("Parameters", {"neval": 11})
+    with pytest.raises(ValueError):
+        ts.set_summary_trigger("Bogus", Trigger.several_iteration(1))
+    ts.add_scalar("Loss", 0.5, 1)
+    assert ts.read_scalar("Loss")[0][:2] == (1, 0.5)
+    ts.close()
+    assert "train" in os.listdir(os.path.join(str(tmp_path), "app"))
+
+
+def test_optimizer_writes_summaries(tmp_path):
+    import jax
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import Optimizer, SGD, Trigger
+
+    rng = np.random.RandomState(0)
+    samples = [Sample(rng.randn(4).astype(np.float32),
+                      np.float32(1.0 + i % 2)) for i in range(16)]
+    ds = DataSet.array(samples) >> SampleToBatch(8)
+    model = nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax()).build(seed=0)
+    opt = Optimizer.create(model, ds, nn.ClassNLLCriterion())
+    ts = TrainSummary(str(tmp_path), "job")
+    vs = ValidationSummary(str(tmp_path), "job")
+    from bigdl_tpu.optim.validation import Top1Accuracy
+    opt.set_optim_method(SGD(learning_rate=0.1)) \
+       .set_end_when(Trigger.max_iteration(4)) \
+       .set_train_summary(ts).set_validation_summary(vs) \
+       .set_validation(Trigger.several_iteration(2), ds, [Top1Accuracy()])
+    opt.optimize()
+    losses = ts.read_scalar("Loss")
+    assert len(losses) >= 3
+    lrs = ts.read_scalar("LearningRate")
+    assert lrs and all(v == pytest.approx(0.1) for _s, v, _t in lrs)
+    thr = ts.read_scalar("Throughput")
+    assert thr and all(v > 0 for _s, v, _t in thr)
+    acc = vs.read_scalar("Top1Accuracy")
+    assert acc and all(0.0 <= v <= 1.0 for _s, v, _t in acc)
+    ts.close(); vs.close()
