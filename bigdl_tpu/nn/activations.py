@@ -183,6 +183,7 @@ class Threshold(Module):
         super().__init__()
         self.th = th
         self.v = v
+        self.ip = ip  # in-place flag kept for API parity; meaningless under XLA
 
     def f(self, params, x, **kw):
         return jnp.where(x > self.th, x, self.v)

@@ -18,6 +18,7 @@ class Dropout(Module):
                  scale: bool = True):
         super().__init__()
         self.p = init_p
+        self.inplace = inplace  # API parity; meaningless under XLA
         self.scale = scale
 
     def f(self, params, x, *, training=False, rng=None, **kw):

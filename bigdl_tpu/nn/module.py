@@ -290,6 +290,26 @@ class Module:
         from bigdl_tpu.utils import file_io
         return file_io.load_module(path)
 
+    def save_torch(self, path: str, overwrite: bool = False) -> "Module":
+        """Write a Torch7-readable .t7 (ref AbstractModule.saveTorch)."""
+        from bigdl_tpu.utils import torch_file
+        torch_file.save_model(self, path, overwrite=overwrite)
+        return self
+
+    @staticmethod
+    def load_torch(path: str) -> "Module":
+        """Load a Torch7 .t7 model (ref Module.loadTorch, nn/Module.scala:31)."""
+        from bigdl_tpu.utils import torch_file
+        return torch_file.load_model(path)
+
+    def load_caffe(self, def_path: str, model_path: str,
+                   match_all: bool = True) -> "Module":
+        """Copy caffe blobs into this model's same-named modules
+        (ref Module.loadCaffe, nn/Module.scala:35-39)."""
+        from bigdl_tpu.utils import caffe_loader
+        self._built()
+        return caffe_loader.load(self, def_path, model_path, match_all)
+
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_jit_cache"] = {}  # jitted callables are not picklable

@@ -1,4 +1,5 @@
 from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils.engine import Engine
+from bigdl_tpu.utils import torch_file
 
-__all__ = ["Table", "T", "Engine"]
+__all__ = ["Table", "T", "Engine", "torch_file"]

@@ -1,0 +1,581 @@
+"""Binary-compatible Torch7 ``.t7`` serialization.
+
+Rebuild of ``utils/TorchFile.scala:36-330``: the t7 format is a little-endian
+stream of tagged objects (NIL=0, NUMBER=1 f64, STRING=2, TABLE=3, TORCH=4,
+BOOLEAN=5); TABLE and TORCH objects carry a heap index for shared-reference
+memoization; TORCH objects carry a version string ("V 1") + class name, then
+a class-specific payload.  Tensors are (i32 ndim, i64 sizes, i64 strides,
+i64 offset(1-based), Storage object); Storages are (i64 n, raw data).
+
+``load``/``save`` handle the raw object graph (numbers, strings, booleans,
+tables, numpy tensors).  ``load_model``/``save_model`` map torch ``nn.*``
+module tables onto ``bigdl_tpu.nn`` layers with the same class coverage as
+the reference reader (TorchFile.scala:144-161) and writer (:257-290).
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, BinaryIO, Dict, Optional
+
+import numpy as np
+
+TYPE_NIL = 0
+TYPE_NUMBER = 1
+TYPE_STRING = 2
+TYPE_TABLE = 3
+TYPE_TORCH = 4
+TYPE_BOOLEAN = 5
+TYPE_FUNCTION = 6
+LEGACY_TYPE_RECUR_FUNCTION = 7
+TYPE_RECUR_FUNCTION = 8
+
+
+@dataclass
+class TorchObject:
+    """A torch class instance that has no native mapping here — carries the
+    class name and its element table so nothing is lost on load."""
+    class_name: str
+    elements: Dict[str, Any] = field(default_factory=dict)
+
+    def get(self, key, default=None):
+        return self.elements.get(key, default)
+
+    def __getitem__(self, key):
+        return self.elements[key]
+
+
+# ----------------------------------------------------------------------- #
+# reader                                                                  #
+# ----------------------------------------------------------------------- #
+
+class _Reader:
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self.memo: Dict[int, Any] = {}
+
+    def _i32(self) -> int:
+        return struct.unpack("<i", self.f.read(4))[0]
+
+    def _i64(self) -> int:
+        return struct.unpack("<q", self.f.read(8))[0]
+
+    def _f64(self) -> float:
+        return struct.unpack("<d", self.f.read(8))[0]
+
+    def _string(self) -> str:
+        n = self._i32()
+        return self.f.read(n).decode("utf-8", "replace")
+
+    def read_object(self) -> Any:
+        type_id = self._i32()
+        if type_id == TYPE_NIL:
+            return None
+        if type_id == TYPE_NUMBER:
+            v = self._f64()
+            return int(v) if v.is_integer() and abs(v) < 2**53 else v
+        if type_id == TYPE_STRING:
+            return self._string()
+        if type_id == TYPE_BOOLEAN:
+            return self._i32() != 0
+        if type_id == TYPE_TABLE:
+            idx = self._i32()
+            if idx in self.memo:
+                return self.memo[idx]
+            result: Dict[Any, Any] = {}
+            self.memo[idx] = result  # pre-register: tables may self-reference
+            n = self._i32()
+            for _ in range(n):
+                k = self.read_object()
+                v = self.read_object()
+                result[k] = v
+            return result
+        if type_id == TYPE_TORCH:
+            idx = self._i32()
+            if idx in self.memo:
+                return self.memo[idx]
+            version = self._string()
+            if version.startswith("V "):
+                class_name = self._string()
+            else:  # legacy files have no version record
+                class_name = version
+            result = self._read_torch(class_name, idx)
+            self.memo[idx] = result
+            return result
+        if type_id in (TYPE_FUNCTION, TYPE_RECUR_FUNCTION,
+                       LEGACY_TYPE_RECUR_FUNCTION):
+            raise NotImplementedError("t7 serialized lua functions")
+        raise ValueError(f"unknown t7 type tag {type_id}")
+
+    _TENSOR_DTYPES = {
+        "torch.FloatTensor": np.float32, "torch.DoubleTensor": np.float64,
+        "torch.LongTensor": np.int64, "torch.IntTensor": np.int32,
+        "torch.ByteTensor": np.uint8, "torch.CharTensor": np.int8,
+        "torch.ShortTensor": np.int16,
+        "torch.CudaTensor": np.float32, "torch.CudaDoubleTensor": np.float64,
+        "torch.CudaLongTensor": np.int64,
+    }
+    _STORAGE_DTYPES = {
+        "torch.FloatStorage": np.float32, "torch.DoubleStorage": np.float64,
+        "torch.LongStorage": np.int64, "torch.IntStorage": np.int32,
+        "torch.ByteStorage": np.uint8, "torch.CharStorage": np.int8,
+        "torch.ShortStorage": np.int16,
+        "torch.CudaStorage": np.float32, "torch.CudaDoubleStorage": np.float64,
+        "torch.CudaLongStorage": np.int64,
+    }
+
+    def _read_torch(self, class_name: str, idx: int) -> Any:
+        if class_name in self._TENSOR_DTYPES:
+            return self._read_tensor()
+        if class_name in self._STORAGE_DTYPES:
+            dtype = self._STORAGE_DTYPES[class_name]
+            n = self._i64()
+            return np.frombuffer(self.f.read(n * np.dtype(dtype).itemsize),
+                                 dtype=dtype).copy()
+        # any other torch class: its payload is one element table
+        elements = self.read_object() or {}
+        str_elems = {k: v for k, v in elements.items() if isinstance(k, str)}
+        # keep the integer-keyed array part too (e.g. container "modules")
+        for k, v in elements.items():
+            if not isinstance(k, str):
+                str_elems[str(k)] = v
+        obj = TorchObject(class_name, str_elems)
+        self.memo[idx] = obj
+        return obj
+
+    def _read_tensor(self) -> Optional[np.ndarray]:
+        ndim = self._i32()
+        sizes = [self._i64() for _ in range(ndim)]
+        strides = [self._i64() for _ in range(ndim)]
+        offset = self._i64()  # 1-based
+        storage = self.read_object()
+        if storage is None or ndim == 0:
+            return np.zeros(sizes, dtype=np.float32) if ndim else None
+        base = storage[offset - 1:]
+        itemsize = base.dtype.itemsize
+        out = np.lib.stride_tricks.as_strided(
+            base, shape=sizes, strides=[s * itemsize for s in strides])
+        return out.copy()
+
+
+# ----------------------------------------------------------------------- #
+# writer                                                                  #
+# ----------------------------------------------------------------------- #
+
+class _Writer:
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self._next_index = 1
+        self._indices: Dict[int, int] = {}  # id(obj) -> heap index
+        self._keepalive = []  # ids are only stable while objects live
+
+    def _i32(self, v: int):
+        self.f.write(struct.pack("<i", v))
+
+    def _i64(self, v: int):
+        self.f.write(struct.pack("<q", v))
+
+    def _f64(self, v: float):
+        self.f.write(struct.pack("<d", v))
+
+    def _string(self, s: str):
+        b = s.encode("utf-8")
+        self._i32(len(b))
+        self.f.write(b)
+
+    def _heap(self, obj) -> Optional[int]:
+        """Returns the index to write, or None if already memoized (in which
+        case the caller writes just the index and stops)."""
+        key = id(obj)
+        if key in self._indices:
+            self._i32(self._indices[key])
+            return None
+        idx = self._next_index
+        self._next_index += 1
+        self._indices[key] = idx
+        self._keepalive.append(obj)
+        self._i32(idx)
+        return idx
+
+    def write_object(self, obj: Any):
+        from bigdl_tpu.nn.module import Module
+        if obj is None:
+            self._i32(TYPE_NIL)
+        elif isinstance(obj, bool):
+            self._i32(TYPE_BOOLEAN)
+            self._i32(1 if obj else 0)
+        elif isinstance(obj, (int, float)):
+            self._i32(TYPE_NUMBER)
+            self._f64(float(obj))
+        elif isinstance(obj, str):
+            self._i32(TYPE_STRING)
+            self._string(obj)
+        elif isinstance(obj, np.ndarray) and obj.dtype == np.int64:
+            # LongStorage (torch stores shape vectors this way)
+            self._i32(TYPE_TORCH)
+            if self._heap(obj) is None:
+                return
+            self._string("V 1")
+            self._string("torch.LongStorage")
+            self._i64(obj.size)
+            self.f.write(np.ascontiguousarray(obj).tobytes())
+        elif hasattr(obj, "shape"):  # numpy / jax array -> tensor
+            self._write_tensor(np.asarray(obj))
+        elif isinstance(obj, Module):
+            write_module(self, obj)
+        elif isinstance(obj, TorchObject):
+            self._i32(TYPE_TORCH)
+            if self._heap(obj) is None:
+                return
+            self._string("V 1")
+            self._string(obj.class_name)
+            self.write_object(dict(obj.elements))
+        elif isinstance(obj, (dict,)):
+            self._i32(TYPE_TABLE)
+            if self._heap(obj) is None:
+                return
+            self._i32(len(obj))
+            for k, v in obj.items():
+                self.write_object(k)
+                self.write_object(v)
+        elif isinstance(obj, (list, tuple)):
+            # lua array-style table, 1-based keys (shares the heap with
+            # dicts so aliased/cyclic lists memoize correctly)
+            self._i32(TYPE_TABLE)
+            if self._heap(obj) is None:
+                return
+            self._i32(len(obj))
+            for i, v in enumerate(obj):
+                self.write_object(i + 1)
+                self.write_object(v)
+        else:
+            raise TypeError(f"cannot serialize {type(obj).__name__} to .t7")
+
+    def _write_tensor(self, arr: np.ndarray):
+        if arr.dtype == np.float32:
+            cls, scls = "torch.FloatTensor", "torch.FloatStorage"
+        elif arr.dtype == np.float64:
+            cls, scls = "torch.DoubleTensor", "torch.DoubleStorage"
+        else:
+            arr = arr.astype(np.float64)
+            cls, scls = "torch.DoubleTensor", "torch.DoubleStorage"
+        self._i32(TYPE_TORCH)
+        if self._heap(arr) is None:
+            return
+        self._string("V 1")
+        self._string(cls)
+        arr = np.ascontiguousarray(arr)
+        self._i32(arr.ndim)
+        for s in arr.shape:
+            self._i64(s)
+        # contiguous strides in elements
+        stride = 1
+        strides = []
+        for s in reversed(arr.shape):
+            strides.append(stride)
+            stride *= s
+        for s in reversed(strides):
+            self._i64(s)
+        self._i64(1)  # storage offset, 1-based
+        # storage sub-object
+        self._i32(TYPE_TORCH)
+        self._i32(self._next_index)
+        self._next_index += 1
+        self._string("V 1")
+        self._string(scls)
+        self._i64(arr.size)
+        self.f.write(arr.tobytes())
+
+
+# ----------------------------------------------------------------------- #
+# public API                                                              #
+# ----------------------------------------------------------------------- #
+
+def load(path: str) -> Any:
+    """Load the first object of a .t7 file (ref TorchFile.load)."""
+    with open(path, "rb") as f:
+        return _Reader(f).read_object()
+
+
+def save(obj: Any, path: str, overwrite: bool = True):
+    import os
+    if not overwrite and os.path.exists(path):
+        raise FileExistsError(path)
+    with open(path, "wb") as f:
+        _Writer(f).write_object(obj)
+
+
+def load_model(path: str):
+    """Load a torch nn model saved as .t7 into bigdl_tpu layers
+    (ref Module.loadTorch, nn/Module.scala:31)."""
+    obj = load(path)
+    return module_from_torch(obj)
+
+
+def _sync_child_shells(m) -> None:
+    """Containers hold the whole params pytree ({"0": ..., "1": ...}) on
+    their own shell; push the slices down so each child's shell sees its own
+    weights (children are exported individually)."""
+    from bigdl_tpu.nn.containers import Container
+    if isinstance(m, Container) and isinstance(m.params, dict):
+        for i, c in enumerate(m.modules):
+            key = str(i)
+            if c.params is None and key in m.params:
+                c.params = m.params[key]
+            if not c.buffers and isinstance(m.buffers, dict) and m.buffers.get(key):
+                c.buffers = m.buffers[key]
+            _sync_child_shells(c)
+
+
+def save_model(model, path: str, overwrite: bool = True):
+    """Save a bigdl_tpu model as a torch-readable .t7 (ref module.saveTorch)."""
+    import os
+    if not overwrite and os.path.exists(path):
+        raise FileExistsError(path)
+    _sync_child_shells(model)
+    with open(path, "wb") as f:
+        write_module(_Writer(f), model)
+
+
+# -- torch nn.* <-> bigdl_tpu.nn mapping -------------------------------- #
+
+def _num(elements, key, default=None):
+    v = elements.get(key, default)
+    return int(v) if v is not None else default
+
+
+def _copy_filter_2d_or_4d(w: np.ndarray, n_out, n_in, kh, kw) -> np.ndarray:
+    """Accept both SpatialConvolutionMM 2-D (out, in*kh*kw) and 4-D layouts."""
+    return np.asarray(w, np.float32).reshape(n_out, n_in, kh, kw)
+
+
+def module_from_torch(obj) -> "Any":
+    m = _module_from_torch(obj)
+    if m.params is None:  # parameterless leaves still need a built shell
+        m.build(seed=0)
+    return m
+
+
+def _module_from_torch(obj) -> "Any":
+    from bigdl_tpu import nn
+    if not isinstance(obj, TorchObject):
+        raise ValueError(f"not a torch module object: {type(obj).__name__}")
+    cls = obj.class_name
+    el = obj.elements
+
+    def seq_children(container):
+        mods = el.get("modules", {})
+        n = len(mods)
+        for i in range(1, n + 1):
+            key = i if i in mods else (str(i) if str(i) in mods else float(i))
+            container.add(module_from_torch(mods[key]))
+        # assemble container params from the already-loaded children —
+        # container.build() would re-randomize them
+        container.params = {str(i): c.params for i, c in enumerate(container.modules)}
+        container.buffers = {str(i): c.buffers for i, c in enumerate(container.modules)}
+        return container
+
+    def with_params(m, **arrays):
+        m.build(seed=0)
+        for name, arr in arrays.items():
+            if arr is not None:
+                m.params[name] = np.asarray(arr, np.float32)
+        return m
+
+    if cls == "nn.Sequential":
+        return seq_children(nn.Sequential())
+    if cls == "nn.Concat":
+        return seq_children(nn.Concat(_num(el, "dimension", 2)))
+    if cls == "nn.ConcatTable":
+        return seq_children(nn.ConcatTable())
+    if cls == "nn.CAddTable":
+        return nn.CAddTable()
+    if cls == "nn.Linear":
+        w = np.asarray(el["weight"], np.float32)
+        m = nn.Linear(w.shape[1], w.shape[0], with_bias="bias" in el)
+        return with_params(m, weight=w, bias=el.get("bias"))
+    if cls in ("nn.SpatialConvolution", "nn.SpatialConvolutionMM"):
+        n_in, n_out = _num(el, "nInputPlane"), _num(el, "nOutputPlane")
+        kw_, kh = _num(el, "kW"), _num(el, "kH")
+        m = nn.SpatialConvolution(
+            n_in, n_out, kw_, kh, _num(el, "dW", 1), _num(el, "dH", 1),
+            _num(el, "padW", 0), _num(el, "padH", 0),
+            with_bias="bias" in el and el["bias"] is not None)
+        w = _copy_filter_2d_or_4d(el["weight"], n_out, n_in, kh, kw_)
+        return with_params(m, weight=w, bias=el.get("bias"))
+    if cls == "nn.SpatialMaxPooling":
+        m = nn.SpatialMaxPooling(_num(el, "kW"), _num(el, "kH"),
+                                 _num(el, "dW"), _num(el, "dH"),
+                                 _num(el, "padW", 0), _num(el, "padH", 0))
+        return m.ceil() if el.get("ceil_mode", False) else m.floor()
+    if cls == "nn.SpatialAveragePooling":
+        return nn.SpatialAveragePooling(
+            _num(el, "kW"), _num(el, "kH"), _num(el, "dW", 1),
+            _num(el, "dH", 1), _num(el, "padW", 0), _num(el, "padH", 0),
+            ceil_mode=el.get("ceil_mode", False),
+            count_include_pad=el.get("count_include_pad", True),
+            divide=el.get("divide", True))
+    if cls in ("nn.BatchNormalization", "nn.SpatialBatchNormalization"):
+        mean = np.asarray(el["running_mean"], np.float32)
+        layer_cls = (nn.SpatialBatchNormalization
+                     if cls == "nn.SpatialBatchNormalization"
+                     else nn.BatchNormalization)
+        m = layer_cls(mean.shape[0], eps=float(el.get("eps", 1e-5)),
+                      momentum=float(el.get("momentum", 0.1)),
+                      affine="weight" in el and el["weight"] is not None)
+        m = with_params(m, weight=el.get("weight"), bias=el.get("bias"))
+        m.buffers["running_mean"] = np.asarray(mean, np.float32)
+        if el.get("running_var") is not None:
+            var = np.asarray(el["running_var"], np.float32)
+        elif el.get("running_std") is not None:
+            # legacy torch stored running_std = 1/sqrt(var + eps)
+            std = np.asarray(el["running_std"], np.float64)
+            var = (std ** -2 - float(el.get("eps", 1e-5))).astype(np.float32)
+        else:
+            var = np.ones_like(mean)
+        m.buffers["running_var"] = var
+        return m
+    if cls == "nn.ReLU":
+        return nn.ReLU(bool(el.get("inplace", False)))
+    if cls == "nn.Tanh":
+        return nn.Tanh()
+    if cls == "nn.Sigmoid":
+        return nn.Sigmoid()
+    if cls == "nn.LogSoftMax":
+        return nn.LogSoftMax()
+    if cls == "nn.SoftMax":
+        return nn.SoftMax()
+    if cls == "nn.Threshold":
+        return nn.Threshold(float(el.get("threshold", 1e-6)),
+                            float(el.get("val", 0.0)),
+                            bool(el.get("inplace", False)))
+    if cls == "nn.Dropout":
+        return nn.Dropout(float(el.get("p", 0.5)),
+                          inplace=bool(el.get("inplace", False)))
+    if cls == "nn.View":
+        return nn.View(tuple(int(s) for s in np.asarray(el["size"]).ravel()))
+    if cls == "nn.Reshape":
+        return nn.Reshape(tuple(int(s) for s in np.asarray(el["size"]).ravel()))
+    if cls == "nn.SpatialZeroPadding":
+        return nn.SpatialZeroPadding(_num(el, "pad_l"), _num(el, "pad_r"),
+                                     _num(el, "pad_t"), _num(el, "pad_b"))
+    if cls == "nn.Identity":
+        return nn.Identity()
+    raise NotImplementedError(f"t7 import of {cls}")
+
+
+def _grad_like(params, name):
+    arr = params.get(name)
+    return np.zeros_like(np.asarray(arr)) if arr is not None else None
+
+
+def write_module(w: _Writer, m) -> None:
+    """Write one bigdl_tpu module as a torch nn.* object (same writable set
+    as the reference, TorchFile.scala:257-290, plus a few extras)."""
+    from bigdl_tpu import nn
+    params = m._built()
+
+    def header(cls_name) -> bool:
+        w._i32(TYPE_TORCH)
+        if w._heap(m) is None:
+            return False
+        w._string("V 1")
+        w._string(cls_name)
+        return True
+
+    def body(**el):
+        el.setdefault("train", bool(m.train))
+        w.write_object({k: v for k, v in el.items()})
+
+    if isinstance(m, nn.Concat):
+        if not header("nn.Concat"):
+            return
+        body(modules={i + 1: c for i, c in enumerate(m.modules)},
+             dimension=float(m.dimension))
+    elif isinstance(m, nn.Sequential):
+        if not header("nn.Sequential"):
+            return
+        body(modules={i + 1: c for i, c in enumerate(m.modules)})
+    elif isinstance(m, nn.Linear):
+        if not header("nn.Linear"):
+            return
+        weight = np.asarray(params["weight"], np.float32)
+        body(weight=weight, bias=np.asarray(params["bias"], np.float32)
+             if "bias" in params else None,
+             gradWeight=np.zeros_like(weight),
+             gradBias=_grad_like(params, "bias"))
+    elif isinstance(m, nn.SpatialConvolution):
+        if m.n_group != 1:
+            raise NotImplementedError("t7 export of grouped convolution")
+        if not header("nn.SpatialConvolutionMM"):
+            return
+        w4 = np.asarray(params["weight"], np.float32)
+        w2 = w4.reshape(m.n_output_plane, -1)  # MM layout (out, in*kh*kw)
+        body(nInputPlane=float(m.n_input_plane),
+             nOutputPlane=float(m.n_output_plane),
+             kW=float(m.kernel_w), kH=float(m.kernel_h),
+             dW=float(m.stride_w), dH=float(m.stride_h),
+             padW=float(m.pad_w), padH=float(m.pad_h),
+             weight=w2, gradWeight=np.zeros_like(w2),
+             bias=np.asarray(params["bias"], np.float32)
+             if "bias" in params else None,
+             gradBias=_grad_like(params, "bias"))
+    elif isinstance(m, nn.SpatialMaxPooling):
+        if not header("nn.SpatialMaxPooling"):
+            return
+        body(kW=float(m.kernel_w), kH=float(m.kernel_h),
+             dW=float(m.stride_w), dH=float(m.stride_h),
+             padW=float(m.pad_w), padH=float(m.pad_h),
+             ceil_mode=bool(m.ceil_mode))
+    elif isinstance(m, nn.ReLU):
+        if not header("nn.ReLU"):
+            return
+        body(inplace=bool(m.ip), threshold=0.0, val=0.0)
+    elif isinstance(m, nn.Threshold):
+        if not header("nn.Threshold"):
+            return
+        body(threshold=float(m.th), val=float(m.v), inplace=bool(m.ip))
+    elif isinstance(m, nn.Dropout):
+        if not header("nn.Dropout"):
+            return
+        body(p=float(m.p), inplace=bool(m.inplace), v2=True)
+    elif isinstance(m, nn.View):
+        if not header("nn.View"):
+            return
+        size = np.asarray(m.sizes, np.int64)
+        body(size=size, numElements=float(int(np.prod(m.sizes))))
+    elif isinstance(m, nn.Reshape):
+        if not header("nn.Reshape"):
+            return
+        size = np.asarray(m.size, np.int64)
+        body(size=size, nelement=float(int(np.prod(m.size))),
+             batchMode=m.batch_mode)
+    elif isinstance(m, nn.LogSoftMax):
+        if not header("nn.LogSoftMax"):
+            return
+        body()
+    elif isinstance(m, nn.Tanh):
+        if not header("nn.Tanh"):
+            return
+        body()
+    elif isinstance(m, nn.Sigmoid):
+        if not header("nn.Sigmoid"):
+            return
+        body()
+    elif isinstance(m, (nn.BatchNormalization,)):
+        cls = ("nn.SpatialBatchNormalization"
+               if isinstance(m, nn.SpatialBatchNormalization)
+               else "nn.BatchNormalization")
+        if not header(cls):
+            return
+        buf = m.buffers or m.init_buffers()
+        body(running_mean=np.asarray(buf["running_mean"], np.float32),
+             running_var=np.asarray(buf["running_var"], np.float32),
+             weight=np.asarray(params["weight"], np.float32)
+             if "weight" in params else None,
+             bias=np.asarray(params["bias"], np.float32)
+             if "bias" in params else None,
+             eps=float(m.eps), momentum=float(m.momentum),
+             affine=bool(m.affine))
+    else:
+        raise NotImplementedError(f"t7 export of {type(m).__name__}")

@@ -1,0 +1,184 @@
+"""Caffe import tests (ref utils/CaffeLoader.scala + CaffeLoaderSpec).
+
+Validated against a synthetic hand-encoded caffemodel binary and — when the
+reference checkout is present — its real test fixture
+(spark/dl/src/test/resources/caffe/, read-only oracle data).
+"""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.caffe_loader import (CaffeLoader, load, parse_caffemodel,
+                                          parse_prototxt)
+
+_REF_DIR = "/root/reference/spark/dl/src/test/resources/caffe"
+
+
+# -- minimal protobuf encoder for building fixtures ---------------------- #
+
+def _varint(n):
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _len_field(fnum, payload):
+    return _varint((fnum << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _int_field(fnum, v):
+    return _varint((fnum << 3) | 0) + _varint(v)
+
+
+def _blob(shape, data, legacy=False):
+    out = b""
+    if legacy:
+        for fnum, v in zip((1, 2, 3, 4), shape):
+            out += _int_field(fnum, v)
+    else:
+        dims = b"".join(_varint(d) for d in shape)
+        out += _len_field(7, _len_field(1, dims))
+    out += _len_field(5, np.asarray(data, "<f4").tobytes())
+    return out
+
+
+def _layer_v2(name, type_, blobs):
+    out = _len_field(1, name.encode()) + _len_field(2, type_.encode())
+    for b in blobs:
+        out += _len_field(7, b)
+    return _len_field(100, out)
+
+
+def _layer_v1(name, type_enum, blobs):
+    out = _len_field(4, name.encode()) + _int_field(5, type_enum)
+    for b in blobs:
+        out += _len_field(6, b)
+    return _len_field(2, out)
+
+
+def test_parse_prototxt():
+    msg = parse_prototxt("""
+      name: "net"  # comment
+      input_dim: 1
+      input_dim: 3
+      layer { name: "conv" type: "Convolution"
+              convolution_param { num_output: 4 pad: 0 } }
+      layer { name: "ip" type: "InnerProduct" }
+    """)
+    assert msg["name"] == "net"
+    assert msg["input_dim"] == [1, 3]
+    assert [l["name"] for l in msg["layer"]] == ["conv", "ip"]
+    assert msg["layer"][0]["convolution_param"]["num_output"] == 4
+
+
+def test_parse_synthetic_caffemodel():
+    w = np.arange(8, dtype=np.float32)
+    raw = (_len_field(1, b"net")
+           + _layer_v2("fc", "InnerProduct", [_blob([2, 4], w),
+                                              _blob([2], [0.5, -0.5])]))
+    net = parse_caffemodel(raw)
+    assert net.name == "net"
+    layer = net.by_name()["fc"]
+    assert layer.type == "InnerProduct"
+    assert layer.blobs[0].shape == [2, 4]
+    np.testing.assert_array_equal(layer.blobs[0].data, w)
+    np.testing.assert_array_equal(layer.blobs[1].data, [0.5, -0.5])
+
+
+def test_parse_v1_layer_with_legacy_blob_dims():
+    w = np.ones(6, np.float32)
+    raw = _layer_v1("old", 14, [_blob([1, 1, 2, 3], w, legacy=True)])
+    net = parse_caffemodel(raw)
+    layer = net.by_name()["old"]
+    assert layer.type == 14
+    assert layer.blobs[0].shape == [1, 1, 2, 3]
+    np.testing.assert_array_equal(layer.blobs[0].data, w)
+
+
+def _write_fixture(tmp_path, raw, proto_text='name: "n"\n'):
+    mp = str(tmp_path / "m.caffemodel")
+    dp = str(tmp_path / "d.prototxt")
+    open(mp, "wb").write(raw)
+    open(dp, "w").write(proto_text)
+    return dp, mp
+
+
+def test_load_copies_weights(tmp_path):
+    w = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    b = np.random.RandomState(1).randn(3).astype(np.float32)
+    raw = _layer_v2("fc1", "InnerProduct", [_blob([3, 4], w), _blob([3], b)])
+    dp, mp = _write_fixture(tmp_path, raw)
+    model = nn.Sequential(nn.Linear(4, 3).set_name("fc1")).build(seed=9)
+    load(model, dp, mp)
+    np.testing.assert_array_equal(np.asarray(model.params["0"]["weight"]), w)
+    np.testing.assert_array_equal(np.asarray(model.params["0"]["bias"]), b)
+
+
+def test_match_all_raises_on_unmapped(tmp_path):
+    dp, mp = _write_fixture(tmp_path, _len_field(1, b"net"))
+    model = nn.Sequential(nn.Linear(4, 3).set_name("nope")).build(seed=0)
+    with pytest.raises(ValueError, match="cannot map"):
+        load(model, dp, mp, match_all=True)
+    # match_all=False keeps initialized params
+    before = np.asarray(model.params["0"]["weight"]).copy()
+    load(model, dp, mp, match_all=False)
+    np.testing.assert_array_equal(np.asarray(model.params["0"]["weight"]), before)
+
+
+def test_element_count_mismatch_raises(tmp_path):
+    raw = _layer_v2("fc1", "InnerProduct", [_blob([2, 2], np.ones(4, np.float32))])
+    dp, mp = _write_fixture(tmp_path, raw)
+    model = nn.Sequential(nn.Linear(4, 3).set_name("fc1")).build(seed=0)
+    with pytest.raises(ValueError, match="element number"):
+        load(model, dp, mp)
+
+
+def test_module_load_caffe_method(tmp_path):
+    w = np.random.RandomState(2).randn(4, 3, 2, 2).astype(np.float32)
+    raw = _layer_v2("conv", "Convolution",
+                    [_blob([4, 3, 2, 2], w.ravel()), _blob([4], np.zeros(4))])
+    dp, mp = _write_fixture(tmp_path, raw)
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 2, 2).set_name("conv")).build(seed=5)
+    model.load_caffe(dp, mp)
+    np.testing.assert_array_equal(np.asarray(model.params["0"]["weight"]), w)
+
+
+@pytest.mark.skipif(not os.path.isdir(_REF_DIR),
+                    reason="reference caffe fixtures not present")
+def test_reads_real_caffemodel_fixture():
+    """Read-only oracle: the reference's caffe test net is conv(3->4,2x2) ->
+    conv(4->3,2x2) -> InnerProduct(27->2, no bias) (test.prototxt)."""
+    dp = os.path.join(_REF_DIR, "test.prototxt")
+    mp = os.path.join(_REF_DIR, "test.caffemodel")
+    proto = parse_prototxt(open(dp).read())
+    assert [l["name"] for l in proto["layer"]] == ["conv", "conv2", "ip"]
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 2, 2).set_name("conv"),
+        nn.SpatialConvolution(4, 3, 2, 2).set_name("conv2"),
+        nn.Reshape((27,)),
+        nn.Linear(27, 2, with_bias=False).set_name("ip"),
+    ).build(seed=1)
+    load(model, dp, mp)
+    net = parse_caffemodel(open(mp, "rb").read())
+    blobs = net.by_name()
+    np.testing.assert_array_equal(
+        np.asarray(model.params["0"]["weight"]).ravel(),
+        blobs["conv"].blobs[0].data)
+    np.testing.assert_array_equal(
+        np.asarray(model.params["3"]["weight"]).ravel(),
+        blobs["ip"].blobs[0].data)
+    # loaded model runs
+    import jax.numpy as jnp
+    x = jnp.ones((1, 3, 5, 5), jnp.float32)
+    y, _ = model.apply(model.params, x)
+    assert y.shape == (1, 2)
