@@ -59,3 +59,6 @@ from bigdl_tpu.nn.criterions import (
 from bigdl_tpu.nn.initialization import (
     InitializationMethod, Default, Xavier, BilinearFiller,
 )
+from bigdl_tpu.nn.attention import (
+    MultiHeadAttention, dot_product_attention, blockwise_attention,
+)

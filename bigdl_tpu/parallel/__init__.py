@@ -14,3 +14,7 @@ from bigdl_tpu.parallel.mesh import (
 )
 from bigdl_tpu.parallel.parameters import AllReduceParameter, CompressedTensor
 from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer, DistriValidator
+from bigdl_tpu.parallel.sequence import (
+    ring_attention, ring_attention_local, ulysses_attention,
+    ulysses_attention_local, sequence_parallel_self_attention,
+)

@@ -1,0 +1,134 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+Capability-gap fill (SURVEY.md §5.7: the reference has no attention and no
+sequence parallelism) designed TPU-first: the sequence dimension is a mesh
+axis; k/v shards rotate around the ring with ``lax.ppermute`` (neighbor
+exchanges ride ICI) while each hop's partial attention merges via the same
+online-softmax update as blockwise attention, so the full (T, T) score
+matrix never exists on any chip.  Ulysses instead trades two
+``lax.all_to_all``s (sequence <-> heads) for full-sequence attention on a
+head subset — cheaper at moderate T, ring wins at long T.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.4.35 top-level API; fall back for older versions
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from bigdl_tpu.nn.attention import (NEG_INF, _block_scores, _finalize,
+                                    online_softmax_update)
+from bigdl_tpu.parallel.mesh import SEQUENCE_AXIS
+
+
+def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
+                         scale: Optional[float] = None):
+    """Per-shard body of ring attention.  Must run inside ``shard_map``
+    (or pmap) with ``axis_name`` bound; q, k, v: (B, H, T_local, D) — the
+    local sequence shard.  Returns the local (B, H, T_local, D) output.
+
+    Round r computes q against the k/v block that started on device
+    (my_index - r) mod N, then passes its current block to the next device
+    (a pure neighbor ppermute: ICI-friendly, no all-gather)."""
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    t_local = q.shape[-2]
+    q_pos = my_idx * t_local + jnp.arange(t_local)  # global positions
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(r, carry):
+        o, l, m, kr, vr = carry
+        src = (my_idx - r) % n  # which shard this k/v block came from
+        mask = None
+        if causal:
+            k_pos = src * t_local + jnp.arange(t_local)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        blk = _block_scores(q, kr, vr, mask, scale)
+        o, l, m = online_softmax_update((o, l, m), blk)
+        kr = lax.ppermute(kr, axis_name, perm)
+        vr = lax.ppermute(vr, axis_name, perm)
+        return o, l, m, kr, vr
+
+    # derive init from q so the carry is marked varying over the shard_map
+    # axis (a plain jnp.zeros would be replicated and fail the vma check)
+    o0 = q * 0.0
+    l0 = q[..., 0] * 0.0
+    m0 = q[..., 0] * 0.0 + NEG_INF
+    o, l, _, _, _ = lax.fori_loop(0, n, step, (o0, l0, m0, k, v))
+    return _finalize(o, l)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, axis: str = SEQUENCE_AXIS,
+                   batch_axis: Optional[str] = None, causal: bool = False):
+    """Global-view ring attention: q, k, v are (B, H, T, D) arrays (sharded
+    or not); T is sharded over ``axis`` and the ring runs over that mesh
+    axis.  On a 2-D mesh pass ``batch_axis`` so the batch dim stays
+    data-sharded instead of being gathered."""
+    spec = P(batch_axis, None, axis, None)
+    fn = shard_map(
+        partial(ring_attention_local, axis_name=axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def ulysses_attention_local(q, k, v, axis_name: str, *,
+                            causal: bool = False,
+                            scale: Optional[float] = None):
+    """Per-shard body of Ulysses (all-to-all) sequence parallelism.  Inside
+    ``shard_map`` with q, k, v: (B, H, T_local, D), H divisible by the axis
+    size: exchange sequence shards for head shards, run full-sequence
+    attention on H/N heads, exchange back."""
+    n = lax.psum(1, axis_name)
+
+    def seq2head(x):  # (B, H, T_local, D) -> (B, H/N, T, D)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def head2seq(x):  # (B, H/N, T, D) -> (B, H, T_local, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = seq2head(q), seq2head(k), seq2head(v)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    mask = None
+    if causal:
+        t = qh.shape[-2]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+    m, l, o = _block_scores(qh, kh, vh, mask, scale)
+    return head2seq(_finalize(o, l))
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *, axis: str = SEQUENCE_AXIS,
+                      batch_axis: Optional[str] = None,
+                      causal: bool = False):
+    """Global-view Ulysses attention (all-to-all sequence parallelism)."""
+    spec = P(batch_axis, None, axis, None)
+    fn = shard_map(
+        partial(ulysses_attention_local, axis_name=axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def sequence_parallel_self_attention(mha, params, x, mesh: Mesh, *,
+                                     axis: str = SEQUENCE_AXIS,
+                                     batch_axis: Optional[str] = None,
+                                     kind: str = "ring"):
+    """Run a ``MultiHeadAttention`` module with its sequence dimension
+    sharded over ``axis``: projections are position-local (stay sharded);
+    the attention core runs as ring or Ulysses.  On a 2-D mesh pass
+    ``batch_axis`` so the batch dim stays data-sharded."""
+    q, k, v = mha.project_qkv(params, x, x, x)
+    attn = ring_attention if kind == "ring" else ulysses_attention
+    o = attn(q, k, v, mesh, axis=axis, batch_axis=batch_axis,
+             causal=mha.causal)
+    return mha.project_out(params, o)

@@ -1,0 +1,147 @@
+"""Attention + sequence-parallelism tests.
+
+Oracle: plain dot_product_attention (itself cross-checked against an
+explicit softmax).  Ring and Ulysses run on the 8-virtual-device CPU mesh
+(conftest) and must match the single-device result exactly (same math,
+different schedule).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.attention import blockwise_attention, dot_product_attention
+from bigdl_tpu.parallel import (SEQUENCE_AXIS, create_mesh, ring_attention,
+                                sequence_parallel_self_attention,
+                                ulysses_attention)
+
+B, H, T, D = 2, 8, 64, 16
+
+
+def _qkv(seed=0, t=T):
+    r = np.random.RandomState(seed)
+    return tuple(jnp.asarray(r.randn(B, H, t, D), jnp.float32) for _ in range(3))
+
+
+def _naive(q, k, v, causal=False):
+    s = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = np.tril(np.ones((tq, tk), bool), k=tk - tq)
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_dot_product_attention_matches_naive(causal):
+    q, k, v = _qkv()
+    got = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), _naive(*map(np.asarray, (q, k, v)),
+                                                       causal=causal),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block_size", [16, 64, 48, 24])  # 48, 24: T=64 not a multiple -> tail padding
+def test_blockwise_matches_plain(causal, block_size):
+    q, k, v = _qkv(1)
+    want = dot_product_attention(q, k, v, causal=causal)
+    got = blockwise_attention(q, k, v, block_size=block_size, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_blockwise_grads_match():
+    q, k, v = _qkv(2)
+    f1 = lambda q, k, v: jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+    f2 = lambda q, k, v: jnp.sum(
+        blockwise_attention(q, k, v, block_size=16, causal=True) ** 2)
+    g1 = jax.grad(f1, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(f2, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_plain(causal):
+    mesh = create_mesh({SEQUENCE_AXIS: 8})
+    q, k, v = _qkv(3)
+    want = dot_product_attention(q, k, v, causal=causal)
+    got = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_under_jit_and_grad():
+    mesh = create_mesh({SEQUENCE_AXIS: 8})
+    q, k, v = _qkv(4)
+
+    @jax.jit
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_plain(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    np.testing.assert_allclose(float(loss_ring(q, k, v)),
+                               float(loss_plain(q, k, v)), rtol=1e-4)
+    g1 = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g2 = jax.grad(loss_plain, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_matches_plain(causal):
+    mesh = create_mesh({SEQUENCE_AXIS: 8})
+    q, k, v = _qkv(5)  # H=8 divisible by axis size 8
+    want = dot_product_attention(q, k, v, causal=causal)
+    got = ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_mha_module_shapes_and_cross_attention():
+    mha = nn.MultiHeadAttention(32, 4, causal=True).build(seed=0)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 10, 32), jnp.float32)
+    y, _ = mha.apply(mha.params, x)
+    assert y.shape == (2, 10, 32)
+    # cross-attention via tuple and Table input
+    from bigdl_tpu.utils.table import T as TT
+    kv = jnp.asarray(np.random.RandomState(1).randn(2, 7, 32), jnp.float32)
+    mha2 = nn.MultiHeadAttention(32, 4).build(seed=0)
+    y2, _ = mha2.apply(mha2.params, (x, kv, kv))
+    assert y2.shape == (2, 10, 32)
+    y3, _ = mha2.apply(mha2.params, TT(x, kv, kv))
+    np.testing.assert_allclose(np.asarray(y2), np.asarray(y3))
+    # causal: output at t must not depend on inputs after t
+    x_mod = x.at[:, 5:, :].set(0.0)
+    y_mod, _ = mha.apply(mha.params, x_mod)
+    np.testing.assert_allclose(np.asarray(y[:, :5]), np.asarray(y_mod[:, :5]),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_mha_blockwise_matches_plain_module():
+    x = jnp.asarray(np.random.RandomState(2).randn(2, 64, 32), jnp.float32)
+    plain = nn.MultiHeadAttention(32, 4, causal=True).build(seed=7)
+    blocked = nn.MultiHeadAttention(32, 4, causal=True, block_size=16).build(seed=7)
+    y1, _ = plain.apply(plain.params, x)
+    y2, _ = blocked.apply(blocked.params, x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+def test_sequence_parallel_self_attention_matches_single_device(kind):
+    mesh = create_mesh({SEQUENCE_AXIS: 8})
+    mha = nn.MultiHeadAttention(32, 8, causal=True).build(seed=3)
+    x = jnp.asarray(np.random.RandomState(3).randn(2, 64, 32), jnp.float32)
+    want, _ = mha.apply(mha.params, x)
+    got = sequence_parallel_self_attention(mha, mha.params, x, mesh, kind=kind)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-5)
