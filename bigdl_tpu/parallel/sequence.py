@@ -46,15 +46,25 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     q_pos = my_idx * t_local + jnp.arange(t_local)  # global positions
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def step(r, carry):
-        o, l, m, kr, vr = carry
+    def compute(r, o, l, m, kr, vr):
         src = (my_idx - r) % n  # which shard this k/v block came from
-        mask = None
-        if causal:
+        if not causal:
+            return online_softmax_update(
+                (o, l, m), _block_scores(q, kr, vr, None, scale))
+
+        # a block strictly in my future (src > my_idx) is fully masked:
+        # cond skips its matmuls and merge at runtime entirely
+        def masked_block(_):
             k_pos = src * t_local + jnp.arange(t_local)
             mask = q_pos[:, None] >= k_pos[None, :]
-        blk = _block_scores(q, kr, vr, mask, scale)
-        o, l, m = online_softmax_update((o, l, m), blk)
+            return online_softmax_update(
+                (o, l, m), _block_scores(q, kr, vr, mask, scale))
+
+        return lax.cond(src > my_idx, lambda _: (o, l, m), masked_block, None)
+
+    def step(r, carry):  # rounds 0..n-2: compute, then rotate k/v onward
+        o, l, m, kr, vr = carry
+        o, l, m = compute(r, o, l, m, kr, vr)
         kr = lax.ppermute(kr, axis_name, perm)
         vr = lax.ppermute(vr, axis_name, perm)
         return o, l, m, kr, vr
@@ -64,7 +74,10 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
     o0 = q * 0.0
     l0 = q[..., 0] * 0.0
     m0 = q[..., 0] * 0.0 + NEG_INF
-    o, l, _, _, _ = lax.fori_loop(0, n, step, (o0, l0, m0, k, v))
+    o, l, m, kr, vr = lax.fori_loop(0, n - 1, step, (o0, l0, m0, k, v))
+    # final round: compute only — rotating k/v once more would be pure
+    # wasted ICI traffic (the carry is discarded)
+    o, l, _ = compute(n - 1, o, l, m, kr, vr)
     return _finalize(o, l)
 
 
@@ -89,6 +102,8 @@ def ulysses_attention_local(q, k, v, axis_name: str, *,
     size: exchange sequence shards for head shards, run full-sequence
     attention on H/N heads, exchange back."""
     n = lax.psum(1, axis_name)
+    assert q.shape[1] % n == 0, \
+        f"Ulysses needs n_head ({q.shape[1]}) divisible by axis size ({n})"
 
     def seq2head(x):  # (B, H, T_local, D) -> (B, H/N, T, D)
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
@@ -127,6 +142,8 @@ def sequence_parallel_self_attention(mha, params, x, mesh: Mesh, *,
     sharded over ``axis``: projections are position-local (stay sharded);
     the attention core runs as ring or Ulysses.  On a 2-D mesh pass
     ``batch_axis`` so the batch dim stays data-sharded."""
+    if kind not in ("ring", "ulysses"):
+        raise ValueError(f"kind must be 'ring' or 'ulysses', got {kind!r}")
     q, k, v = mha.project_qkv(params, x, x, x)
     attn = ring_attention if kind == "ring" else ulysses_attention
     o = attn(q, k, v, mesh, axis=axis, batch_axis=batch_axis,

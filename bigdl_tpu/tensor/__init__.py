@@ -1,0 +1,729 @@
+"""Torch-verb Tensor facade (ref tensor/Tensor.scala:35, TensorMath.scala:28).
+
+The reference's tensor layer (SURVEY.md §2.2) is a strided view over a flat
+JVM array with Torch semantics: 1-based indexing, aliasing ``narrow /
+select / view``, in-place math.  On TPU the *compute* path is ``jax.numpy``
+under ``jax.jit`` — XLA plays MKL's role — so this facade is deliberately a
+**host-side** tensor backed by numpy (mutation-friendly, strided, aliasing),
+used by the interop layers (.t7 / Caffe loaders), data pipeline, and user
+code that expects the Torch API.  ``to_jax()`` / ``from_jax()`` bridge to
+device arrays at the jit boundary.
+
+Dim / index arguments are 1-based exactly like the reference
+(``tensor/DenseTensor.scala:30-35``); negative dims are not supported, as in
+Torch7.  Methods ending in ``_`` or documented as in-place mutate the
+underlying storage (and therefore every aliasing view), matching
+``narrow``'s aliasing contract that the reference's flattened-parameter
+trick relies on (``nn/Module.scala:41``).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import numpy as np
+
+from bigdl_tpu.utils.rng import RNG
+
+__all__ = ["Tensor", "Storage"]
+
+Number = Union[int, float]
+
+
+class Storage:
+    """Flat 1-D storage (ref tensor/Storage.scala). Wraps a numpy 1-D array."""
+
+    def __init__(self, data: Union[int, Sequence, np.ndarray], dtype=np.float32):
+        if isinstance(data, (int, np.integer)):
+            self._arr = np.zeros(int(data), dtype=dtype)
+        else:
+            self._arr = np.ascontiguousarray(np.asarray(data, dtype=dtype)).reshape(-1)
+
+    def array(self) -> np.ndarray:
+        return self._arr
+
+    def __len__(self) -> int:
+        return self._arr.size
+
+    def __getitem__(self, i: int):  # 1-based
+        return self._arr[i - 1].item()
+
+    def __setitem__(self, i: int, v) -> None:  # 1-based
+        self._arr[i - 1] = v
+
+    def copy(self, other: "Storage") -> "Storage":
+        np.copyto(self._arr, other._arr)
+        return self
+
+    def fill(self, v, offset: int = 1, length: Optional[int] = None) -> "Storage":
+        length = len(self) - offset + 1 if length is None else length
+        self._arr[offset - 1:offset - 1 + length] = v
+        return self
+
+
+def _as_np(x):
+    if isinstance(x, Tensor):
+        return x._np()
+    return x
+
+
+class Tensor:
+    """N-d strided tensor with Torch verbs over a flat Storage.
+
+    Constructors::
+
+        Tensor()                      # empty
+        Tensor(3, 4)                  # zeros of shape (3,4)
+        Tensor([3, 4])                # zeros of shape (3,4)
+        Tensor(np_array)              # copy of an ndarray
+        Tensor(storage, offset, sizes, strides)  # aliasing view
+    """
+
+    def __init__(self, *args, dtype=np.float32):
+        if len(args) == 0:
+            self._set_view(Storage(0, dtype), 1, (), ())
+            return
+        a0 = args[0]
+        if isinstance(a0, Storage):
+            offset = args[1] if len(args) > 1 else 1
+            sizes = tuple(args[2]) if len(args) > 2 and args[2] is not None else (len(a0),)
+            strides = tuple(args[3]) if len(args) > 3 and args[3] is not None \
+                else _contiguous_strides(sizes)
+            self._set_view(a0, offset, sizes, strides)
+        elif isinstance(a0, Tensor):
+            arr = np.array(a0._np())
+            self._from_array(arr)
+        elif isinstance(a0, np.ndarray):
+            self._from_array(np.array(a0, dtype=a0.dtype if a0.dtype.kind == "f" or
+                                      a0.dtype.kind in "iu" else dtype))
+        elif isinstance(a0, (list, tuple)) and len(args) == 1:
+            arr0 = np.asarray(a0)
+            if arr0.dtype.kind in "iu" and arr0.ndim == 1 and not any(
+                    isinstance(e, (list, tuple, np.ndarray, float)) for e in a0):
+                # Tensor([3,4]) = zeros of that shape (Torch convention)
+                sizes = tuple(int(s) for s in a0)
+                st = Storage(int(np.prod(sizes)) if sizes else 0, dtype)
+                self._set_view(st, 1, sizes, _contiguous_strides(sizes))
+            else:
+                self._from_array(np.asarray(a0, dtype=dtype))
+        else:  # Tensor(3, 4, ...)
+            sizes = tuple(int(s) for s in args)
+            st = Storage(int(np.prod(sizes)) if sizes else 0, dtype)
+            self._set_view(st, 1, sizes, _contiguous_strides(sizes))
+
+    # ---------------------------------------------------------------- #
+    # internals                                                        #
+    # ---------------------------------------------------------------- #
+    def _set_view(self, storage: Storage, offset: int, sizes, strides) -> None:
+        self._storage = storage
+        self._offset = int(offset)
+        self._sizes = tuple(int(s) for s in sizes)
+        self._strides = tuple(int(s) for s in strides)
+
+    def _from_array(self, arr: np.ndarray) -> None:
+        arr = np.ascontiguousarray(arr)
+        st = Storage(arr.reshape(-1), dtype=arr.dtype)
+        self._set_view(st, 1, arr.shape, _contiguous_strides(arr.shape))
+
+    def _np(self) -> np.ndarray:
+        """A (possibly aliasing) numpy view of this tensor."""
+        base = self._storage.array()
+        if self.dim() == 0:
+            return base[self._offset - 1:self._offset - 1]
+        itemsize = base.itemsize
+        return np.lib.stride_tricks.as_strided(
+            base[self._offset - 1:],
+            shape=self._sizes,
+            strides=tuple(s * itemsize for s in self._strides),
+            writeable=True,
+        )
+
+    # ---------------------------------------------------------------- #
+    # shape / metadata (ref Tensor.scala:35-200)                       #
+    # ---------------------------------------------------------------- #
+    def dim(self) -> int:
+        return len(self._sizes)
+
+    n_dimension = dim
+    nDimension = dim
+
+    def size(self, dim: Optional[int] = None):
+        if dim is None:
+            return tuple(self._sizes)
+        return self._sizes[dim - 1]
+
+    def stride(self, dim: Optional[int] = None):
+        if dim is None:
+            return tuple(self._strides)
+        return self._strides[dim - 1]
+
+    def n_element(self) -> int:
+        return int(np.prod(self._sizes)) if self._sizes else 0
+
+    nElement = n_element
+
+    def storage(self) -> Storage:
+        return self._storage
+
+    def storage_offset(self) -> int:
+        return self._offset
+
+    def is_contiguous(self) -> bool:
+        return self._strides == _contiguous_strides(self._sizes)
+
+    def contiguous(self) -> "Tensor":
+        if self.is_contiguous():
+            return self
+        return Tensor(self._np())
+
+    @property
+    def dtype(self):
+        return self._storage.array().dtype
+
+    # ---------------------------------------------------------------- #
+    # element access (1-based)                                         #
+    # ---------------------------------------------------------------- #
+    def value_at(self, *indices: int):
+        return float(self._np()[tuple(i - 1 for i in indices)])
+
+    valueAt = value_at
+
+    def set_value(self, *args) -> "Tensor":
+        *indices, v = args
+        self._np()[tuple(i - 1 for i in indices)] = v
+        return self
+
+    setValue = set_value
+
+    def __getitem__(self, idx):
+        if isinstance(idx, int):
+            if self.dim() == 1:
+                return self.value_at(idx)
+            return self.select(1, idx)
+        if isinstance(idx, tuple):
+            return self.value_at(*idx)
+        raise TypeError(f"unsupported index {idx!r}")
+
+    def __setitem__(self, idx, v) -> None:
+        if isinstance(idx, int):
+            if self.dim() == 1:
+                self.set_value(idx, v)
+            else:
+                self.select(1, idx).copy(v if isinstance(v, Tensor) else Tensor(np.asarray(v)))
+        elif isinstance(idx, tuple):
+            self.set_value(*idx, v)
+        else:
+            raise TypeError(f"unsupported index {idx!r}")
+
+    # ---------------------------------------------------------------- #
+    # views (aliasing, ref Tensor.scala narrow/select/view/…)          #
+    # ---------------------------------------------------------------- #
+    def narrow(self, dim: int, index: int, size: int) -> "Tensor":
+        d = dim - 1
+        assert 1 <= index and index + size - 1 <= self._sizes[d], "narrow out of range"
+        offset = self._offset + (index - 1) * self._strides[d]
+        sizes = list(self._sizes)
+        sizes[d] = size
+        return Tensor(self._storage, offset, sizes, self._strides)
+
+    def select(self, dim: int, index: int) -> "Tensor":
+        d = dim - 1
+        assert self.dim() > 0, "cannot select on a scalar"
+        offset = self._offset + (index - 1) * self._strides[d]
+        sizes = self._sizes[:d] + self._sizes[d + 1:]
+        strides = self._strides[:d] + self._strides[d + 1:]
+        return Tensor(self._storage, offset, sizes, strides)
+
+    def view(self, *sizes) -> "Tensor":
+        sizes = _unpack_sizes(sizes)
+        sizes = _infer_size(sizes, self.n_element())
+        assert self.is_contiguous(), "view requires a contiguous tensor"
+        return Tensor(self._storage, self._offset, sizes, _contiguous_strides(sizes))
+
+    def reshape(self, *sizes) -> "Tensor":
+        sizes = _infer_size(_unpack_sizes(sizes), self.n_element())
+        return Tensor(self._np().reshape(sizes))
+
+    def transpose(self, dim1: int, dim2: int) -> "Tensor":
+        d1, d2 = dim1 - 1, dim2 - 1
+        sizes = list(self._sizes)
+        strides = list(self._strides)
+        sizes[d1], sizes[d2] = sizes[d2], sizes[d1]
+        strides[d1], strides[d2] = strides[d2], strides[d1]
+        return Tensor(self._storage, self._offset, sizes, strides)
+
+    def t(self) -> "Tensor":
+        assert self.dim() == 2, "t() expects a 2D tensor"
+        return self.transpose(1, 2)
+
+    def unfold(self, dim: int, size: int, step: int) -> "Tensor":
+        d = dim - 1
+        n = (self._sizes[d] - size) // step + 1
+        sizes = self._sizes[:d] + (n,) + self._sizes[d + 1:] + (size,)
+        strides = self._strides[:d] + (self._strides[d] * step,) + \
+            self._strides[d + 1:] + (self._strides[d],)
+        return Tensor(self._storage, self._offset, sizes, strides)
+
+    def expand(self, *sizes) -> "Tensor":
+        sizes = _unpack_sizes(sizes)
+        assert len(sizes) == self.dim()
+        strides = list(self._strides)
+        for i, (have, want) in enumerate(zip(self._sizes, sizes)):
+            if have != want:
+                assert have == 1, f"cannot expand dim {i+1} from {have} to {want}"
+                strides[i] = 0
+        return Tensor(self._storage, self._offset, sizes, strides)
+
+    def expand_as(self, other: "Tensor") -> "Tensor":
+        return self.expand(*other.size())
+
+    def squeeze(self, dim: Optional[int] = None) -> "Tensor":
+        if dim is None:
+            keep = [i for i, s in enumerate(self._sizes) if s != 1]
+        else:
+            keep = [i for i in range(self.dim()) if not (i == dim - 1 and self._sizes[i] == 1)]
+        sizes = tuple(self._sizes[i] for i in keep)
+        strides = tuple(self._strides[i] for i in keep)
+        return Tensor(self._storage, self._offset, sizes, strides)
+
+    def unsqueeze(self, dim: int) -> "Tensor":
+        d = dim - 1
+        sizes = self._sizes[:d] + (1,) + self._sizes[d:]
+        stride_here = self._strides[d] * self._sizes[d] if d < self.dim() else 1
+        strides = self._strides[:d] + (stride_here,) + self._strides[d:]
+        return Tensor(self._storage, self._offset, sizes, strides)
+
+    def split(self, size: int, dim: int = 1) -> list["Tensor"]:
+        out, i = [], 1
+        total = self._sizes[dim - 1]
+        while i <= total:
+            out.append(self.narrow(dim, i, min(size, total - i + 1)))
+            i += size
+        return out
+
+    def set(self, other: Optional["Tensor"] = None, storage: Optional[Storage] = None,
+            storage_offset: int = 1, sizes=None, strides=None) -> "Tensor":
+        """Re-point this tensor at another tensor's storage (ref Tensor.set)."""
+        if other is not None:
+            self._set_view(other._storage, other._offset, other._sizes, other._strides)
+        elif storage is not None:
+            sizes = tuple(sizes) if sizes is not None else (len(storage),)
+            strides = tuple(strides) if strides is not None else _contiguous_strides(sizes)
+            self._set_view(storage, storage_offset, sizes, strides)
+        else:
+            self._set_view(Storage(0, self.dtype), 1, (), ())
+        return self
+
+    def resize(self, *sizes) -> "Tensor":
+        sizes = _unpack_sizes(sizes)
+        n = int(np.prod(sizes)) if sizes else 0
+        if n > len(self._storage) - self._offset + 1 or not self.is_contiguous():
+            self._set_view(Storage(n, self.dtype), 1, sizes, _contiguous_strides(sizes))
+        else:
+            self._set_view(self._storage, self._offset, sizes, _contiguous_strides(sizes))
+        return self
+
+    def resize_as(self, other: "Tensor") -> "Tensor":
+        return self.resize(*other.size())
+
+    resizeAs = resize_as
+
+    # ---------------------------------------------------------------- #
+    # fill / randomization (in-place)                                  #
+    # ---------------------------------------------------------------- #
+    def fill(self, v: Number) -> "Tensor":
+        self._np()[...] = v
+        return self
+
+    def zero(self) -> "Tensor":
+        return self.fill(0)
+
+    def copy(self, other: "Tensor") -> "Tensor":
+        np.copyto(self._np(), np.broadcast_to(other._np(), self._sizes))
+        return self
+
+    def rand(self) -> "Tensor":
+        vals = [RNG.uniform(0.0, 1.0) for _ in range(self.n_element())]
+        self._assign_flat(vals)
+        return self
+
+    def randn(self) -> "Tensor":
+        vals = [RNG.normal(0.0, 1.0) for _ in range(self.n_element())]
+        self._assign_flat(vals)
+        return self
+
+    def bernoulli(self, p: float) -> "Tensor":
+        vals = [RNG.bernoulli(p) for _ in range(self.n_element())]
+        self._assign_flat(vals)
+        return self
+
+    def _assign_flat(self, vals) -> None:
+        view = self._np()
+        np.copyto(view, np.asarray(vals, dtype=self.dtype).reshape(self._sizes))
+
+    def apply1(self, fn) -> "Tensor":
+        view = self._np()
+        it = np.nditer(view, flags=["multi_index"], op_flags=["readwrite"])
+        for x in it:
+            x[...] = fn(float(x))
+        return self
+
+    # ---------------------------------------------------------------- #
+    # math (ref TensorMath.scala:28-642) — out-of-place unless noted   #
+    # ---------------------------------------------------------------- #
+    def _wrap(self, arr: np.ndarray) -> "Tensor":
+        return Tensor(np.asarray(arr, dtype=self.dtype))
+
+    def __add__(self, other):
+        return self._wrap(self._np() + _as_np(other))
+
+    def __radd__(self, other):
+        return self._wrap(_as_np(other) + self._np())
+
+    def __sub__(self, other):
+        return self._wrap(self._np() - _as_np(other))
+
+    def __rsub__(self, other):
+        return self._wrap(_as_np(other) - self._np())
+
+    def __mul__(self, other):
+        return self._wrap(self._np() * _as_np(other))
+
+    def __rmul__(self, other):
+        return self._wrap(_as_np(other) * self._np())
+
+    def __truediv__(self, other):
+        return self._wrap(self._np() / _as_np(other))
+
+    def __neg__(self):
+        return self._wrap(-self._np())
+
+    # in-place accumulate family (Torch add/cmul/… mutate the receiver)
+    def add(self, *args) -> "Tensor":
+        """add(value) | add(tensor) | add(alpha, tensor) — in place."""
+        if len(args) == 1:
+            self._np()[...] += _as_np(args[0])
+        else:
+            alpha, t = args
+            self._np()[...] += alpha * _as_np(t)
+        return self
+
+    def sub(self, *args) -> "Tensor":
+        if len(args) == 1:
+            self._np()[...] -= _as_np(args[0])
+        else:
+            alpha, t = args
+            self._np()[...] -= alpha * _as_np(t)
+        return self
+
+    def cmul(self, other: "Tensor") -> "Tensor":
+        self._np()[...] *= _as_np(other)
+        return self
+
+    def cdiv(self, other: "Tensor") -> "Tensor":
+        self._np()[...] /= _as_np(other)
+        return self
+
+    def mul(self, v: Number) -> "Tensor":
+        self._np()[...] *= v
+        return self
+
+    def div(self, v: Number) -> "Tensor":
+        self._np()[...] /= v
+        return self
+
+    def addcmul(self, value: Number, t1: "Tensor", t2: "Tensor") -> "Tensor":
+        self._np()[...] += value * (_as_np(t1) * _as_np(t2))
+        return self
+
+    def addcdiv(self, value: Number, t1: "Tensor", t2: "Tensor") -> "Tensor":
+        self._np()[...] += value * (_as_np(t1) / _as_np(t2))
+        return self
+
+    # BLAS family
+    def addmm(self, *args) -> "Tensor":
+        """addmm([beta,] [alpha,] mat1, mat2): self = beta*self + alpha*mat1@mat2."""
+        beta, alpha, m1, m2 = _parse_blas_args(args)
+        self._np()[...] = beta * self._np() + alpha * (_as_np(m1) @ _as_np(m2))
+        return self
+
+    def addmv(self, *args) -> "Tensor":
+        beta, alpha, m, v = _parse_blas_args(args)
+        self._np()[...] = beta * self._np() + alpha * (_as_np(m) @ _as_np(v))
+        return self
+
+    def addr(self, *args) -> "Tensor":
+        beta, alpha, v1, v2 = _parse_blas_args(args)
+        self._np()[...] = beta * self._np() + alpha * np.outer(_as_np(v1), _as_np(v2))
+        return self
+
+    def baddbmm(self, *args) -> "Tensor":
+        beta, alpha, b1, b2 = _parse_blas_args(args)
+        self._np()[...] = beta * self._np() + alpha * np.matmul(_as_np(b1), _as_np(b2))
+        return self
+
+    def mm(self, m1: "Tensor", m2: "Tensor") -> "Tensor":
+        r = _as_np(m1) @ _as_np(m2)
+        self.resize(*r.shape)
+        self._np()[...] = r
+        return self
+
+    def mv(self, m: "Tensor", v: "Tensor") -> "Tensor":
+        r = _as_np(m) @ _as_np(v)
+        self.resize(*r.shape)
+        self._np()[...] = r
+        return self
+
+    def bmm(self, b1: "Tensor", b2: "Tensor") -> "Tensor":
+        r = np.matmul(_as_np(b1), _as_np(b2))
+        self.resize(*r.shape)
+        self._np()[...] = r
+        return self
+
+    def dot(self, other: "Tensor") -> float:
+        return float(np.dot(self._np().reshape(-1), _as_np(other).reshape(-1)))
+
+    # elementwise transcendental (in-place, mirrors MKL VML usage)
+    def pow(self, n: Number) -> "Tensor":
+        self._np()[...] = np.power(self._np(), n)
+        return self
+
+    def log(self) -> "Tensor":
+        self._np()[...] = np.log(self._np())
+        return self
+
+    def exp(self) -> "Tensor":
+        self._np()[...] = np.exp(self._np())
+        return self
+
+    def sqrt(self) -> "Tensor":
+        self._np()[...] = np.sqrt(self._np())
+        return self
+
+    def log1p(self) -> "Tensor":
+        self._np()[...] = np.log1p(self._np())
+        return self
+
+    def abs(self) -> "Tensor":
+        self._np()[...] = np.abs(self._np())
+        return self
+
+    # reductions
+    def sum(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(self._np().sum())
+        return self._wrap(self._np().sum(axis=dim - 1, keepdims=True))
+
+    def mean(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(self._np().mean())
+        return self._wrap(self._np().mean(axis=dim - 1, keepdims=True))
+
+    def max(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(self._np().max())
+        a = self._np()
+        vals = a.max(axis=dim - 1, keepdims=True)
+        idx = a.argmax(axis=dim - 1) + 1  # 1-based
+        return self._wrap(vals), Tensor(np.expand_dims(idx, dim - 1).astype(np.float32))
+
+    def min(self, dim: Optional[int] = None):
+        if dim is None:
+            return float(self._np().min())
+        a = self._np()
+        vals = a.min(axis=dim - 1, keepdims=True)
+        idx = a.argmin(axis=dim - 1) + 1
+        return self._wrap(vals), Tensor(np.expand_dims(idx, dim - 1).astype(np.float32))
+
+    def topk(self, k: int, dim: Optional[int] = None, increase: bool = True):
+        """(values, 1-based indices) of the k smallest (increase) or largest."""
+        a = self._np()
+        d = (dim if dim is not None else self.dim()) - 1
+        order = np.argsort(a, axis=d, kind="stable")
+        if not increase:
+            order = np.flip(order, axis=d)
+        idx = np.take(order, np.arange(k), axis=d)
+        vals = np.take_along_axis(a, idx, axis=d)
+        return self._wrap(vals), Tensor((idx + 1).astype(np.float32))
+
+    def norm(self, p: Number = 2) -> float:
+        a = self._np().reshape(-1)
+        if p == 1:
+            return float(np.abs(a).sum())
+        return float(np.power(np.power(np.abs(a), p).sum(), 1.0 / p))
+
+    def dist(self, other: "Tensor", p: Number = 2) -> float:
+        return (self - other).norm(p)
+
+    def uniform(self, a: float = 0.0, b: float = 1.0) -> float:
+        return RNG.uniform(a, b)
+
+    # comparison masks (out-of-place, 0/1 tensors like the reference)
+    def gt(self, other) -> "Tensor":
+        return self._wrap((self._np() > _as_np(other)).astype(self.dtype))
+
+    def lt(self, other) -> "Tensor":
+        return self._wrap((self._np() < _as_np(other)).astype(self.dtype))
+
+    def le(self, other) -> "Tensor":
+        return self._wrap((self._np() <= _as_np(other)).astype(self.dtype))
+
+    def ge(self, other) -> "Tensor":
+        return self._wrap((self._np() >= _as_np(other)).astype(self.dtype))
+
+    def eq(self, other) -> "Tensor":
+        return self._wrap((self._np() == _as_np(other)).astype(self.dtype))
+
+    def masked_fill(self, mask: "Tensor", v: Number) -> "Tensor":
+        self._np()[_as_np(mask).astype(bool)] = v
+        return self
+
+    maskedFill = masked_fill
+
+    def masked_copy(self, mask: "Tensor", src: "Tensor") -> "Tensor":
+        m = _as_np(mask).astype(bool)
+        self._np()[m] = _as_np(src).reshape(-1)[: int(m.sum())]
+        return self
+
+    maskedCopy = masked_copy
+
+    def masked_select(self, mask: "Tensor") -> "Tensor":
+        return self._wrap(self._np()[_as_np(mask).astype(bool)])
+
+    maskedSelect = masked_select
+
+    # scatter / gather (1-based index tensors, ref TensorMath.scala)
+    def gather(self, dim: int, index: "Tensor") -> "Tensor":
+        idx = (_as_np(index) - 1).astype(np.int64)
+        return self._wrap(np.take_along_axis(self._np(), idx, axis=dim - 1))
+
+    def scatter(self, dim: int, index: "Tensor", src: "Tensor") -> "Tensor":
+        idx = (_as_np(index) - 1).astype(np.int64)
+        np.put_along_axis(self._np(), idx, _as_np(src), axis=dim - 1)
+        return self
+
+    def index_select(self, dim: int, indices: "Tensor") -> "Tensor":
+        idx = (_as_np(indices).astype(np.int64).reshape(-1) - 1)
+        return self._wrap(np.take(self._np(), idx, axis=dim - 1))
+
+    # conv2 / xcorr2 (ref DenseTensorConv.scala — 'valid' mode)
+    def conv2(self, kernel: "Tensor") -> "Tensor":
+        return self._wrap(_corr2(self._np(), np.flip(_as_np(kernel))))
+
+    def xcorr2(self, kernel: "Tensor") -> "Tensor":
+        return self._wrap(_corr2(self._np(), _as_np(kernel)))
+
+    # ---------------------------------------------------------------- #
+    # interop                                                          #
+    # ---------------------------------------------------------------- #
+    def numpy(self) -> np.ndarray:
+        return np.array(self._np())
+
+    def to_jax(self):
+        import jax.numpy as jnp
+        return jnp.asarray(self._np())
+
+    @staticmethod
+    def from_jax(arr) -> "Tensor":
+        return Tensor(np.asarray(arr))
+
+    def clone(self) -> "Tensor":
+        return Tensor(np.array(self._np()))
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Tensor):
+            return NotImplemented
+        return self._sizes == other._sizes and np.array_equal(self._np(), other._np())
+
+    def __hash__(self):
+        return id(self)
+
+    def almost_equal(self, other: "Tensor", tol: float = 1e-6) -> bool:
+        return self._sizes == other._sizes and \
+            np.allclose(self._np(), other._np(), atol=tol, rtol=0)
+
+    def __repr__(self) -> str:
+        return f"Tensor(size={self._sizes})\n{self._np()!r}"
+
+    # ---------------------------------------------------------------- #
+    # factories (ref Tensor object, Tensor.scala:610-897)              #
+    # ---------------------------------------------------------------- #
+    @staticmethod
+    def ones(*sizes, dtype=np.float32) -> "Tensor":
+        return Tensor(np.ones(_unpack_sizes(sizes), dtype=dtype))
+
+    @staticmethod
+    def zeros(*sizes, dtype=np.float32) -> "Tensor":
+        return Tensor(np.zeros(_unpack_sizes(sizes), dtype=dtype))
+
+    @staticmethod
+    def arange(xmin: Number, xmax: Number, step: Number = 1) -> "Tensor":
+        """Inclusive range like Torch's torch.range."""
+        # epsilon guards float quotients that land just below an integer
+        # (e.g. 0.3/0.1 -> 2.9999...), which would drop the endpoint
+        n = int(np.floor((xmax - xmin) / step + 1e-7)) + 1
+        return Tensor((xmin + step * np.arange(n)).astype(np.float32))
+
+    range = arange
+
+    @staticmethod
+    def randperm(n: int) -> "Tensor":
+        """1-based random permutation drawn from the shared Torch RNG."""
+        return Tensor(RNG.current().randperm(n).astype(np.float32))
+
+    @staticmethod
+    def gaussian1D(size: int = 3, sigma: float = 0.25, amplitude: float = 1.0,
+                   normalize: bool = False, mean: float = 0.5, tensor=None) -> "Tensor":
+        """1-D gaussian kernel (ref Tensor.scala:827-897)."""
+        center = mean * size + 0.5
+        x = np.arange(1, size + 1, dtype=np.float64)
+        g = amplitude * np.exp(-(((x - center) / (sigma * size)) ** 2) / 2)
+        if normalize:
+            g = g / g.sum()
+        out = Tensor(g.astype(np.float32))
+        if tensor is not None:
+            tensor.resize(size)
+            tensor._np()[...] = out._np()
+            return tensor
+        return out
+
+
+def _contiguous_strides(sizes) -> tuple:
+    strides, acc = [], 1
+    for s in reversed(sizes):
+        strides.append(acc)
+        acc *= s
+    return tuple(reversed(strides))
+
+
+def _unpack_sizes(sizes):
+    if len(sizes) == 1 and isinstance(sizes[0], (list, tuple)):
+        return tuple(int(s) for s in sizes[0])
+    return tuple(int(s) for s in sizes)
+
+
+def _infer_size(sizes, numel):
+    sizes = list(sizes)
+    if -1 in sizes:
+        i = sizes.index(-1)
+        rest = int(np.prod([s for s in sizes if s != -1])) or 1
+        sizes[i] = numel // rest
+    return tuple(sizes)
+
+
+def _parse_blas_args(args):
+    """[beta,] [alpha,] t1, t2 → (beta, alpha, t1, t2)."""
+    nums = [a for a in args if isinstance(a, (int, float)) and not isinstance(a, Tensor)]
+    tensors = [a for a in args if isinstance(a, (Tensor, np.ndarray))]
+    assert len(tensors) == 2, "expected two tensor operands"
+    if len(nums) == 0:
+        return 1.0, 1.0, tensors[0], tensors[1]
+    if len(nums) == 1:
+        return 1.0, nums[0], tensors[0], tensors[1]
+    return nums[0], nums[1], tensors[0], tensors[1]
+
+
+def _corr2(a: np.ndarray, k: np.ndarray) -> np.ndarray:
+    """2-D 'valid' cross-correlation (ref DenseTensorConv.scala:262)."""
+    oh, ow = a.shape[0] - k.shape[0] + 1, a.shape[1] - k.shape[1] + 1
+    win = np.lib.stride_tricks.sliding_window_view(a, k.shape)
+    return np.einsum("ijkl,kl->ij", win[:oh, :ow], k)

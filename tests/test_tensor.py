@@ -1,0 +1,270 @@
+"""Tensor facade tests (ref tensor/DenseTensorSpec, DenseTensorMathSpec)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu.tensor import Storage, Tensor
+
+
+class TestShape:
+    def test_construct_sizes(self):
+        t = Tensor(3, 4)
+        assert t.dim() == 2 and t.size() == (3, 4) and t.n_element() == 12
+        assert t.size(1) == 3 and t.size(2) == 4
+        assert t.stride() == (4, 1)
+
+    def test_construct_from_array(self):
+        t = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert t.size() == (2, 3)
+        assert t.value_at(2, 3) == 5.0
+
+    def test_one_based_get_set(self):
+        t = Tensor(2, 2)
+        t.set_value(1, 1, 7).set_value(2, 2, 9)
+        assert t[1, 1] == 7.0 and t[2, 2] == 9.0
+        assert t.storage()[1] == 7.0  # storage is 1-based too
+
+    def test_narrow_aliases(self):
+        t = Tensor(np.zeros((4, 3), np.float32))
+        n = t.narrow(1, 2, 2)  # rows 2..3
+        n.fill(5)
+        assert t.value_at(1, 1) == 0 and t.value_at(2, 1) == 5 and t.value_at(3, 3) == 5
+        assert t.value_at(4, 1) == 0
+
+    def test_select(self):
+        t = Tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        row2 = t.select(1, 2)
+        assert row2.size() == (4,)
+        assert row2.value_at(1) == 4.0
+        row2.fill(-1)  # aliases
+        assert t.value_at(2, 3) == -1
+
+    def test_view_and_reshape(self):
+        t = Tensor(np.arange(6, dtype=np.float32))
+        v = t.view(2, 3)
+        assert v.size() == (2, 3) and v.value_at(2, 1) == 3.0
+        v2 = t.view(3, -1)
+        assert v2.size() == (3, 2)
+
+    def test_transpose_t(self):
+        t = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        tt = t.t()
+        assert tt.size() == (3, 2) and tt.value_at(3, 1) == 2.0
+        assert not tt.is_contiguous() and tt.contiguous().is_contiguous()
+
+    def test_unfold(self):
+        t = Tensor(np.arange(7, dtype=np.float32))
+        u = t.unfold(1, 3, 2)  # windows [0,1,2],[2,3,4],[4,5,6]
+        assert u.size() == (3, 3)
+        assert u.value_at(2, 1) == 2.0 and u.value_at(3, 3) == 6.0
+
+    def test_expand(self):
+        t = Tensor(np.array([[1.0], [2.0]], np.float32))
+        e = t.expand(2, 3)
+        assert e.size() == (2, 3) and e.value_at(2, 3) == 2.0
+
+    def test_squeeze_unsqueeze(self):
+        t = Tensor(1, 3, 1)
+        assert t.squeeze().size() == (3,)
+        assert t.squeeze(3).size() == (1, 3)
+        assert Tensor(3).unsqueeze(1).size() == (1, 3)
+
+    def test_split(self):
+        t = Tensor(np.arange(10, dtype=np.float32))
+        parts = t.split(4)
+        assert [p.size(1) for p in parts] == [4, 4, 2]
+        assert parts[2].value_at(1) == 8.0
+
+    def test_set_shares_storage(self):
+        a = Tensor(np.arange(4, dtype=np.float32))
+        b = Tensor()
+        b.set(a)
+        b.fill(9)
+        assert a.value_at(1) == 9.0
+
+    def test_resize(self):
+        t = Tensor(2, 2)
+        t.resize(3, 3)
+        assert t.size() == (3, 3)
+
+
+class TestMath:
+    def test_add_scalar_tensor_alpha(self):
+        t = Tensor(np.ones((2, 2), np.float32))
+        t.add(1.0)
+        assert t.value_at(1, 1) == 2.0
+        t.add(2.0, Tensor(np.ones((2, 2), np.float32)))
+        assert t.value_at(2, 2) == 4.0
+
+    def test_operators(self):
+        a = Tensor(np.full((2,), 3.0, np.float32))
+        b = Tensor(np.full((2,), 2.0, np.float32))
+        assert (a + b).value_at(1) == 5.0
+        assert (a - b).value_at(1) == 1.0
+        assert (a * b).value_at(1) == 6.0
+        assert (a / b).value_at(1) == 1.5
+        assert (2.0 * a).value_at(1) == 6.0
+        assert (-a).value_at(1) == -3.0
+
+    def test_cmul_cdiv_addcmul(self):
+        a = Tensor(np.full((3,), 6.0, np.float32))
+        a.cmul(Tensor(np.full((3,), 2.0, np.float32)))
+        assert a.value_at(1) == 12.0
+        a.cdiv(Tensor(np.full((3,), 3.0, np.float32)))
+        assert a.value_at(1) == 4.0
+        a.addcmul(0.5, Tensor(np.full((3,), 2.0, np.float32)),
+                  Tensor(np.full((3,), 2.0, np.float32)))
+        assert a.value_at(1) == 6.0
+
+    def test_addmm_mm(self):
+        m1 = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        m2 = Tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        out = Tensor(2, 2).zero().addmm(m1, m2)
+        expect = m1.numpy() @ m2.numpy()
+        np.testing.assert_allclose(out.numpy(), expect)
+        out2 = Tensor().mm(m1, m2)
+        np.testing.assert_allclose(out2.numpy(), expect)
+
+    def test_addmm_beta_alpha(self):
+        c = Tensor(np.ones((2, 2), np.float32))
+        m = Tensor(np.eye(2, dtype=np.float32))
+        c.addmm(2.0, 3.0, m, m)  # 2*1 + 3*I
+        np.testing.assert_allclose(c.numpy(), 2 * np.ones((2, 2)) + 3 * np.eye(2))
+
+    def test_mv_dot_addr_bmm(self):
+        m = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        v = Tensor(np.ones(3, np.float32))
+        assert Tensor().mv(m, v).numpy().tolist() == [3.0, 12.0]
+        assert Tensor(np.array([1.0, 2.0], np.float32)).dot(
+            Tensor(np.array([3.0, 4.0], np.float32))) == 11.0
+        r = Tensor(2, 2).zero().addr(Tensor(np.array([1.0, 2.0], np.float32)),
+                                     Tensor(np.array([3.0, 4.0], np.float32)))
+        np.testing.assert_allclose(r.numpy(), [[3, 4], [6, 8]])
+        b = Tensor(np.ones((2, 2, 2), np.float32))
+        np.testing.assert_allclose(Tensor().bmm(b, b).numpy(), 2 * np.ones((2, 2, 2)))
+
+    def test_transcendental(self):
+        t = Tensor(np.array([1.0, 4.0], np.float32))
+        assert t.clone().sqrt().numpy().tolist() == [1.0, 2.0]
+        np.testing.assert_allclose(t.clone().log().numpy(), np.log([1.0, 4.0]), rtol=1e-6)
+        np.testing.assert_allclose(t.clone().exp().numpy(), np.exp([1.0, 4.0]), rtol=1e-6)
+        assert t.clone().pow(2).numpy().tolist() == [1.0, 16.0]
+        assert Tensor(np.array([-2.0], np.float32)).abs().value_at(1) == 2.0
+
+    def test_reductions(self):
+        t = Tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        assert t.sum() == 15.0 and t.mean() == 2.5
+        assert t.sum(1).numpy().tolist() == [[3.0, 5.0, 7.0]]
+        assert t.max() == 5.0 and t.min() == 0.0
+        vals, idx = t.max(2)
+        assert vals.numpy().reshape(-1).tolist() == [2.0, 5.0]
+        assert idx.numpy().reshape(-1).tolist() == [3.0, 3.0]  # 1-based
+
+    def test_topk(self):
+        t = Tensor(np.array([[3.0, 1.0, 2.0]], np.float32))
+        vals, idx = t.topk(2)  # 2 smallest, increasing
+        assert vals.numpy().tolist() == [[1.0, 2.0]]
+        assert idx.numpy().tolist() == [[2.0, 3.0]]
+        vals, idx = t.topk(1, increase=False)
+        assert vals.numpy().tolist() == [[3.0]] and idx.numpy().tolist() == [[1.0]]
+
+    def test_norm_dist(self):
+        t = Tensor(np.array([3.0, 4.0], np.float32))
+        assert t.norm(2) == pytest.approx(5.0)
+        assert t.norm(1) == pytest.approx(7.0)
+        assert t.dist(Tensor(np.zeros(2, np.float32))) == pytest.approx(5.0)
+
+    def test_masks(self):
+        t = Tensor(np.array([1.0, 5.0, 3.0], np.float32))
+        assert t.gt(2.0).numpy().tolist() == [0.0, 1.0, 1.0]
+        assert t.le(3.0).numpy().tolist() == [1.0, 0.0, 1.0]
+        assert t.eq(5.0).numpy().tolist() == [0.0, 1.0, 0.0]
+        m = t.gt(2.0)
+        sel = t.masked_select(m)
+        assert sel.numpy().tolist() == [5.0, 3.0]
+        t.masked_fill(m, 0.0)
+        assert t.numpy().tolist() == [1.0, 0.0, 0.0]
+
+    def test_gather_scatter(self):
+        t = Tensor(np.arange(1, 7, dtype=np.float32).reshape(2, 3))
+        idx = Tensor(np.array([[1.0], [3.0]], np.float32))
+        g = t.gather(2, idx)
+        assert g.numpy().reshape(-1).tolist() == [1.0, 6.0]
+        t.scatter(2, idx, Tensor(np.array([[9.0], [9.0]], np.float32)))
+        assert t.value_at(1, 1) == 9.0 and t.value_at(2, 3) == 9.0
+
+    def test_index_select(self):
+        t = Tensor(np.arange(6, dtype=np.float32).reshape(3, 2))
+        s = t.index_select(1, Tensor(np.array([3.0, 1.0], np.float32)))
+        assert s.numpy().tolist() == [[4.0, 5.0], [0.0, 1.0]]
+
+    def test_conv2_xcorr2(self):
+        a = Tensor(np.arange(9, dtype=np.float32).reshape(3, 3))
+        k = Tensor(np.array([[0.0, 1.0], [2.0, 3.0]], np.float32))
+        x = a.xcorr2(k)
+        expect = np.array([[1 * 1 + 3 * 2 + 4 * 3, 2 + 4 * 2 + 5 * 3],
+                           [4 + 6 * 2 + 7 * 3, 5 + 7 * 2 + 8 * 3]], np.float32)
+        np.testing.assert_allclose(x.numpy(), expect)
+        # conv2 == xcorr2 with flipped kernel
+        np.testing.assert_allclose(
+            a.conv2(k).numpy(),
+            a.xcorr2(Tensor(np.flip(k.numpy()).copy())).numpy())
+
+
+class TestFactoriesAndRandom:
+    def test_ones_zeros_range(self):
+        assert Tensor.ones(2, 2).numpy().tolist() == [[1.0, 1.0], [1.0, 1.0]]
+        assert Tensor.zeros(3).numpy().tolist() == [0.0, 0.0, 0.0]
+        assert Tensor.arange(1, 5).numpy().tolist() == [1.0, 2.0, 3.0, 4.0, 5.0]
+        assert Tensor.arange(0, 10, 5).numpy().tolist() == [0.0, 5.0, 10.0]
+
+    def test_randperm(self):
+        p = Tensor.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(1, 11))
+
+    def test_gaussian1D(self):
+        g = Tensor.gaussian1D(5, normalize=True)
+        assert g.size() == (5,)
+        assert g.numpy().sum() == pytest.approx(1.0, abs=1e-6)
+        assert g.numpy().argmax() == 2  # centered
+
+    def test_rand_deterministic(self):
+        from bigdl_tpu.utils.rng import RNG
+        RNG.set_seed(42)
+        a = Tensor(4).rand().numpy()
+        RNG.set_seed(42)
+        b = Tensor(4).rand().numpy()
+        np.testing.assert_array_equal(a, b)
+        assert ((0 <= a) & (a < 1)).all()
+
+    def test_bernoulli(self):
+        from bigdl_tpu.utils.rng import RNG
+        RNG.set_seed(1)
+        t = Tensor(1000).bernoulli(0.3)
+        assert 0.2 < t.numpy().mean() < 0.4
+
+    def test_storage(self):
+        s = Storage([1.0, 2.0, 3.0])
+        assert len(s) == 3 and s[2] == 2.0
+        s[1] = 9.0
+        assert s[1] == 9.0
+        s.fill(0.0, 2, 2)
+        assert s.array().tolist() == [9.0, 0.0, 0.0]
+
+
+class TestInterop:
+    def test_jax_roundtrip(self):
+        t = Tensor(np.arange(4, dtype=np.float32).reshape(2, 2))
+        j = t.to_jax()
+        assert j.shape == (2, 2)
+        t2 = Tensor.from_jax(j)
+        assert t2.almost_equal(t)
+
+    def test_clone_independent(self):
+        a = Tensor(np.ones(3, np.float32))
+        b = a.clone()
+        b.fill(2)
+        assert a.value_at(1) == 1.0
+
+    def test_apply1(self):
+        t = Tensor(np.array([1.0, 2.0], np.float32)).apply1(lambda x: x * 10)
+        assert t.numpy().tolist() == [10.0, 20.0]
