@@ -39,6 +39,21 @@ def write_shard(path: str, records: Iterable[ByteRecord]) -> int:
 
 
 def read_shard(path: str) -> Iterator[ByteRecord]:
+    try:  # native one-pass indexer (csrc/bigdl_tpu_native.cpp bt_shard_index)
+        from bigdl_tpu import native
+        lib = native.get()
+    except Exception:
+        lib = None
+    if lib is not None:
+        with open(path, "rb") as f:
+            buf = f.read()
+        try:
+            offsets, lengths, labels = lib.shard_index(buf)
+        except ValueError as e:
+            raise ValueError(f"{path}: {e}") from None
+        for off, length, label in zip(offsets, lengths, labels):
+            yield ByteRecord(buf[off:off + length], float(label))
+        return
     with open(path, "rb") as f:
         magic = f.read(len(MAGIC))
         if magic != MAGIC:

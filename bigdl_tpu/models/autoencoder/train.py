@@ -1,0 +1,62 @@
+"""Autoencoder MNIST training CLI (ref models/autoencoder/Train.scala:
+reconstruction target = the normalized input, MSE criterion).
+
+    python -m bigdl_tpu.models.autoencoder.train -f /path/to/mnist
+    python -m bigdl_tpu.models.autoencoder.train --synthetic
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def _to_autoencoder_batch():
+    """MiniBatch(data, labels) -> MiniBatch(data, flattened data): the
+    reconstruction target is the input itself (ref Train.scala
+    toAutoencoderBatch)."""
+    from bigdl_tpu.dataset.transformer import Transformer
+    from bigdl_tpu.dataset.types import MiniBatch
+
+    class ToAutoencoderBatch(Transformer):
+        def transform_one(self, batch: MiniBatch) -> MiniBatch:
+            return MiniBatch(batch.data, batch.data.reshape(batch.data.shape[0], -1))
+
+    return ToAutoencoderBatch()
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Train Autoencoder on MNIST")
+    p.add_argument("-f", "--folder", default="./")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("-b", "--batchSize", type=int, default=150)
+    p.add_argument("-e", "--maxEpoch", type=int, default=10)
+    p.add_argument("-r", "--learningRate", type=float, default=0.01)
+    p.add_argument("--synthetic", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, image, mnist
+    from bigdl_tpu.models.autoencoder import Autoencoder
+    from bigdl_tpu.optim import Adagrad, Optimizer, Trigger
+
+    Engine.init()
+    records = mnist.synthetic(2048) if args.synthetic else \
+        mnist.load(args.folder, train=True)
+    # ref: normalize to [0,1] (mean 0, std 255) — sigmoid output range
+    pipe = (image.BytesToGreyImg(28, 28)
+            >> image.GreyImgNormalizer(0.0, 255.0)
+            >> image.GreyImgToBatch(args.batchSize))
+    train_ds = DataSet.array(records) >> pipe >> _to_autoencoder_batch()
+
+    model = Autoencoder(32).build(seed=1)
+    optimizer = Optimizer.create(model, train_ds, nn.MSECriterion())
+    optimizer.set_optim_method(Adagrad(learning_rate=args.learningRate)) \
+             .set_end_when(Trigger.max_epoch(args.maxEpoch))
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Inception ImageNet evaluation CLI (ref models/inception/Test.scala)."""
+from __future__ import annotations
+
+import argparse
+import glob
+import logging
+import os
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Evaluate Inception on ImageNet")
+    p.add_argument("-f", "--folder", default="./", help="record shard dir")
+    p.add_argument("--model", required=True)
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--synthetic", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, image
+    from bigdl_tpu.optim import LocalValidator, Top1Accuracy, Top5Accuracy
+
+    Engine.init()
+    if args.synthetic:
+        from bigdl_tpu.models.inception.train import _synthetic_records
+        ds = DataSet.array(_synthetic_records(128, seed=9))
+    else:
+        shards = sorted(glob.glob(os.path.join(args.folder, "*")))
+        val = [s for s in shards if "val" in os.path.basename(s)] or shards
+        ds = DataSet.record_files(val)
+    ds = ds >> image.MTLabeledBGRImgToBatch(
+        224, 224, args.batchSize,
+        image.BytesToBGRImg() >> image.BGRImgCropper(224, 224)
+        >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
+    model = nn.Module.load(args.model)
+    for method, result in LocalValidator(model, ds).test(
+            [Top1Accuracy(), Top5Accuracy()]):
+        print(f"{method} is {result}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Inception-v1/v2 ImageNet training CLI (ref models/inception/Train.scala
++ Options.scala: seqfile folder input, 224x224 crop pipeline, SGD with
+poly decay).
+
+    python -m bigdl_tpu.models.inception.train -f /path/to/shards --modelName inception_v1
+    python -m bigdl_tpu.models.inception.train --synthetic
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import logging
+import os
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Train Inception on ImageNet")
+    p.add_argument("-f", "--folder", default="./",
+                   help="dir of packed record shards (SequenceFile equivalent)")
+    p.add_argument("--modelName", default="inception_v1",
+                   choices=["inception_v1", "inception_v2"])
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--model", default=None)
+    p.add_argument("--state", default=None)
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("-i", "--maxIteration", type=int, default=62000)
+    p.add_argument("-r", "--learningRate", type=float, default=0.01)
+    p.add_argument("--weightDecay", type=float, default=0.0002)
+    p.add_argument("--classNumber", type=int, default=1000)
+    p.add_argument("--distributed", action="store_true")
+    p.add_argument("--synthetic", action="store_true")
+    return p
+
+
+def _synthetic_records(n: int, seed: int = 0):
+    """Encoded-image ByteRecords with a learnable color/label correlation."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from bigdl_tpu.dataset.types import ByteRecord
+
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        label = i % 10
+        img = rng.randint(0, 60, size=(256, 256, 3)).astype(np.uint8)
+        img[:, :, label % 3] += np.uint8(120)
+        buf = io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        out.append(ByteRecord(buf.getvalue(), float(label) + 1.0))
+    return out
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, image
+    from bigdl_tpu.models.inception import Inception_v1, Inception_v2
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Top5Accuracy, Trigger
+    from bigdl_tpu.optim.optim_method import Poly
+
+    Engine.init()
+    if args.synthetic:
+        n = max(args.batchSize * 8, 64)
+        train_ds = DataSet.array(_synthetic_records(n))
+        val_ds = DataSet.array(_synthetic_records(max(n // 4, 32), seed=9))
+        class_num = 10
+    else:
+        shards = sorted(glob.glob(os.path.join(args.folder, "*")))
+        train = [s for s in shards if "train" in os.path.basename(s)] or shards
+        val = [s for s in shards if "val" in os.path.basename(s)] or shards[:1]
+        train_ds = DataSet.record_files(train, distributed=args.distributed)
+        val_ds = DataSet.record_files(val)
+        class_num = args.classNumber
+
+    # ref ImageNet2012 pipeline: decode, random 224-crop + flip, normalize
+    train_pipe = image.MTLabeledBGRImgToBatch(
+        224, 224, args.batchSize,
+        image.BytesToBGRImg() >> image.BGRImgRdmCropper(224, 224)
+        >> image.HFlip(0.5)
+        >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
+    val_pipe = image.MTLabeledBGRImgToBatch(
+        224, 224, args.batchSize,
+        image.BytesToBGRImg() >> image.BGRImgCropper(224, 224)
+        >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
+    train_ds = train_ds >> train_pipe
+    val_ds = val_ds >> val_pipe
+
+    factory = Inception_v1 if args.modelName == "inception_v1" else Inception_v2
+    model = nn.Module.load(args.model) if args.model else \
+        factory(class_num).build(seed=1)
+    # ref Train.scala: poly lr decay to maxIteration
+    method = SGD(learning_rate=args.learningRate, weight_decay=args.weightDecay,
+                 learning_rate_schedule=Poly(0.5, args.maxIteration))
+    optimizer = Optimizer.create(model, train_ds, nn.ClassNLLCriterion())
+    if args.state:
+        from bigdl_tpu.utils import file_io
+        snap = file_io.load(args.state)
+        optimizer.set_state(snap["driver_state"])
+        if snap.get("optim_state") is not None:
+            method._state = snap["optim_state"]
+    optimizer.set_optim_method(method) \
+             .set_end_when(Trigger.max_iteration(args.maxIteration)) \
+             .set_validation(Trigger.several_iteration(620), val_ds,
+                             [Top1Accuracy(), Top5Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.several_iteration(620))
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""ResNet CIFAR-10 evaluation CLI (ref models/resnet/Test.scala)."""
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Evaluate ResNet on CIFAR-10")
+    p.add_argument("-f", "--folder", default="./")
+    p.add_argument("--model", required=True)
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("--synthetic", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, cifar, image
+    from bigdl_tpu.optim import LocalValidator, Top1Accuracy
+
+    Engine.init()
+    records = cifar.synthetic(512, seed=9) if args.synthetic else \
+        cifar.load(args.folder, train=False)
+    ds = DataSet.array(records) >> (
+        image.BGRImgNormalizer(cifar.TRAIN_MEAN, cifar.TRAIN_STD)
+        >> image.BGRImgToBatch(args.batchSize))
+    model = nn.Module.load(args.model)
+    for method, result in LocalValidator(model, ds).test([Top1Accuracy()]):
+        print(f"{method} is {result}")
+
+
+if __name__ == "__main__":
+    main()

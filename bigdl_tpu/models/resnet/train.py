@@ -1,0 +1,83 @@
+"""ResNet CIFAR-10 training CLI (ref models/resnet/Train.scala).
+
+    python -m bigdl_tpu.models.resnet.train -f /path/to/cifar --depth 20
+    python -m bigdl_tpu.models.resnet.train --synthetic
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Train ResNet on CIFAR-10")
+    p.add_argument("-f", "--folder", default="./")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--model", default=None)
+    p.add_argument("--state", default=None)
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("-e", "--nepochs", type=int, default=165)
+    p.add_argument("--depth", type=int, default=20, help="6n+2 for cifar10")
+    p.add_argument("--shortcutType", default="A", choices=["A", "B", "C"])
+    p.add_argument("-r", "--learningRate", type=float, default=0.1)
+    p.add_argument("--weightDecay", type=float, default=1e-4)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--distributed", action="store_true")
+    p.add_argument("--synthetic", action="store_true")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, cifar, image
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.optim_method import EpochSchedule, Regime
+
+    Engine.init()
+    if args.synthetic:
+        train_records, test_records = cifar.synthetic(2048), cifar.synthetic(512, seed=9)
+    else:
+        train_records = cifar.load(args.folder, train=True)
+        test_records = cifar.load(args.folder, train=False)
+    mean, std = cifar.TRAIN_MEAN, cifar.TRAIN_STD
+
+    # ref resnet training augmentation: pad-and-random-crop + flip; the
+    # loader yields 32x32 so random crop degenerates unless padded upstream
+    train_pipe = (image.HFlip(0.5)
+                  >> image.BGRImgNormalizer(mean, std)
+                  >> image.BGRImgToBatch(args.batchSize))
+    val_pipe = (image.BGRImgNormalizer(mean, std)
+                >> image.BGRImgToBatch(args.batchSize))
+    train_ds = DataSet.array(train_records, distributed=args.distributed) >> train_pipe
+    val_ds = DataSet.array(test_records) >> val_pipe
+
+    model = nn.Module.load(args.model) if args.model else \
+        ResNet(10, depth=args.depth, shortcut_type=args.shortcutType,
+               dataset="cifar10").build(seed=1)
+    # ref Train.scala cifar regime: lr, lr/10 after epoch 81, /100 after 122
+    schedule = EpochSchedule([Regime(1, 80, 1.0), Regime(81, 121, 0.1),
+                              Regime(122, 100000, 0.01)])
+    method = SGD(learning_rate=args.learningRate, weight_decay=args.weightDecay,
+                 momentum=args.momentum, dampening=0.0, nesterov=True,
+                 learning_rate_schedule=schedule)
+    optimizer = Optimizer.create(model, train_ds, nn.ClassNLLCriterion())
+    if args.state:
+        from bigdl_tpu.utils import file_io
+        snap = file_io.load(args.state)
+        optimizer.set_state(snap["driver_state"])
+        if snap.get("optim_state") is not None:
+            method._state = snap["optim_state"]
+    optimizer.set_optim_method(method) \
+             .set_end_when(Trigger.max_epoch(args.nepochs)) \
+             .set_validation(Trigger.every_epoch(), val_ds, [Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

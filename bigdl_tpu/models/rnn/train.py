@@ -1,0 +1,80 @@
+"""SimpleRNN language-model training CLI (ref models/rnn/Train.scala:62-90:
+read text, build Dictionary, train Recurrent(RnnCell) with
+TimeDistributedCriterion(CrossEntropy)).
+
+    python -m bigdl_tpu.models.rnn.train -f input.txt --vocabSize 4000
+    python -m bigdl_tpu.models.rnn.train --synthetic
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+_SYNTH = ("the quick brown fox jumps over the lazy dog. "
+          "pack my box with five dozen liquor jugs. "
+          "how vexingly quick daft zebras jump! ") * 40
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Train SimpleRNN language model")
+    p.add_argument("-f", "--folder", default=None, help="input text file")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--model", default=None)
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("-e", "--maxEpoch", type=int, default=30)
+    p.add_argument("-r", "--learningRate", type=float, default=0.1)
+    p.add_argument("--vocabSize", type=int, default=4000)
+    p.add_argument("--hiddenSize", type=int, default=40)
+    p.add_argument("--seqLength", type=int, default=24)
+    p.add_argument("--cell", default="rnn", choices=["rnn", "lstm"])
+    p.add_argument("--synthetic", action="store_true")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, text
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.models.rnn import LstmLM, SimpleRNN
+    from bigdl_tpu.optim import Loss, Optimizer, SGD, Trigger
+
+    Engine.init()
+    if args.synthetic or not args.folder:
+        raw = _SYNTH
+    else:
+        with open(args.folder) as f:
+            raw = f.read()
+
+    tokenize = text.SentenceSplitter() >> text.SentenceTokenizer() \
+        >> text.SentenceBiPadding()
+    token_lists = list(tokenize([raw]))
+    dictionary = text.Dictionary(token_lists, vocab_size=args.vocabSize)
+    vocab = dictionary.vocab_size()
+    pad_label = dictionary.get_index(text.SENTENCE_END) + 1
+
+    pipe = (text.TextToLabeledSentence(dictionary)
+            >> text.LabeledSentenceToSample(vocab, fixed_length=args.seqLength,
+                                            pad_label=pad_label)
+            >> SampleToBatch(args.batchSize))
+    split = int(len(token_lists) * 0.8) or 1
+    train_ds = DataSet.array(token_lists[:split]) >> pipe
+    val_ds = DataSet.array(token_lists[split:] or token_lists[:1]) >> pipe
+
+    factory = SimpleRNN if args.cell == "rnn" else LstmLM
+    model = nn.Module.load(args.model) if args.model else \
+        factory(vocab, args.hiddenSize, vocab).build(seed=1)
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    optimizer = Optimizer.create(model, train_ds, criterion)
+    optimizer.set_optim_method(SGD(learning_rate=args.learningRate)) \
+             .set_end_when(Trigger.max_epoch(args.maxEpoch)) \
+             .set_validation(Trigger.every_epoch(), val_ds, [Loss(criterion)])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""VGG CIFAR-10 training CLI (ref models/vgg/Train.scala).
+
+    python -m bigdl_tpu.models.vgg.train -f /path/to/cifar -b 128
+    python -m bigdl_tpu.models.vgg.train --synthetic
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Train VGG on CIFAR-10")
+    p.add_argument("-f", "--folder", default="./", help="CIFAR-10 data dir")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--model", default=None, help="model snapshot to resume")
+    p.add_argument("--state", default=None, help="state snapshot to resume")
+    p.add_argument("-b", "--batchSize", type=int, default=128)
+    p.add_argument("-e", "--maxEpoch", type=int, default=90)
+    p.add_argument("-r", "--learningRate", type=float, default=0.01)
+    p.add_argument("--weightDecay", type=float, default=0.0005)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--distributed", action="store_true")
+    p.add_argument("--synthetic", action="store_true")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, cifar, image
+    from bigdl_tpu.models.vgg import VggForCifar10
+    from bigdl_tpu.optim import Optimizer, SGD, Top1Accuracy, Trigger
+    from bigdl_tpu.optim.optim_method import EpochStep
+
+    Engine.init()
+    if args.synthetic:
+        train_records, test_records = cifar.synthetic(2048), cifar.synthetic(512, seed=9)
+    else:
+        train_records = cifar.load(args.folder, train=True)
+        test_records = cifar.load(args.folder, train=False)
+    mean, std = cifar.TRAIN_MEAN, cifar.TRAIN_STD
+
+    train_pipe = (image.HFlip(0.5)
+                  >> image.BGRImgNormalizer(mean, std)
+                  >> image.BGRImgToBatch(args.batchSize))
+    val_pipe = (image.BGRImgNormalizer(mean, std)
+                >> image.BGRImgToBatch(args.batchSize))
+    train_ds = DataSet.array(train_records, distributed=args.distributed) >> train_pipe
+    val_ds = DataSet.array(test_records) >> val_pipe
+
+    model = nn.Module.load(args.model) if args.model else VggForCifar10(10).build(seed=1)
+    # ref Train.scala: lr/2 every 25 epochs via EpochStep(25, 0.5)
+    method = SGD(learning_rate=args.learningRate, weight_decay=args.weightDecay,
+                 momentum=args.momentum, dampening=0.0,
+                 learning_rate_schedule=EpochStep(25, 0.5))
+    optimizer = Optimizer.create(model, train_ds, nn.ClassNLLCriterion())
+    if args.state:
+        from bigdl_tpu.utils import file_io
+        snap = file_io.load(args.state)
+        optimizer.set_state(snap["driver_state"])
+        if snap.get("optim_state") is not None:
+            method._state = snap["optim_state"]
+    optimizer.set_optim_method(method) \
+             .set_end_when(Trigger.max_epoch(args.maxEpoch)) \
+             .set_validation(Trigger.every_epoch(), val_ds, [Top1Accuracy()])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

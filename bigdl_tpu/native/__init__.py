@@ -1,0 +1,206 @@
+"""ctypes bindings for the native runtime library (csrc/bigdl_tpu_native.cpp).
+
+The reference backs its hot host loops with a native core library loaded via
+JNI (SURVEY.md §2.1: BigDL-core/MKL, ``MKL.isMKLLoaded`` gating fallbacks at
+``tensor/TensorNumeric.scala:297-316``).  Here the native library covers the
+host *runtime* (CRC framing, bulk Torch-RNG, shard indexing) — device math
+is XLA's job — and every caller has a pure-python fallback, so ``lib`` being
+``None`` only costs speed, exactly like a missing MKL did.
+
+Build happens on demand with g++ (cached next to this file); set
+``BIGDL_TPU_NO_NATIVE=1`` to force the fallbacks.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, os.pardir, os.pardir, "csrc", "bigdl_tpu_native.cpp")
+_SO = os.path.join(_HERE, "libbigdl_tpu_native.so")
+
+_lock = threading.Lock()
+
+
+def _build() -> bool:
+    src = os.path.abspath(_SRC)
+    if not os.path.exists(src):
+        return False
+    tmp = _SO + f".tmp{os.getpid()}"
+    try:
+        subprocess.run(["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                        "-o", tmp, src],
+                       check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return True
+    except Exception:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    if os.environ.get("BIGDL_TPU_NO_NATIVE") in ("1", "true"):
+        return None
+    with _lock:
+        if not os.path.exists(_SO) or (
+                os.path.exists(_SRC)
+                and os.path.getmtime(_SRC) > os.path.getmtime(_SO)):
+            if not _build():
+                return None
+        try:
+            dll = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    # c_char_p: C never writes through these, so bytes pass zero-copy
+    dll.bt_crc32c.restype = ctypes.c_uint32
+    dll.bt_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32]
+    dll.bt_crc32.restype = ctypes.c_uint32
+    dll.bt_crc32.argtypes = [ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint32]
+    dll.bt_mt_new.restype = ctypes.c_void_p
+    dll.bt_mt_new.argtypes = [ctypes.c_uint64]
+    dll.bt_mt_free.argtypes = [ctypes.c_void_p]
+    dll.bt_mt_set_seed.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    dll.bt_mt_random.restype = ctypes.c_double
+    dll.bt_mt_random.argtypes = [ctypes.c_void_p]
+    dll.bt_mt_random_int.restype = ctypes.c_uint32
+    dll.bt_mt_random_int.argtypes = [ctypes.c_void_p]
+    dll.bt_mt_uniform.argtypes = [ctypes.c_void_p, f64p, ctypes.c_int64,
+                                  ctypes.c_double, ctypes.c_double]
+    dll.bt_mt_normal.argtypes = [ctypes.c_void_p, f64p, ctypes.c_int64,
+                                 ctypes.c_double, ctypes.c_double]
+    dll.bt_mt_bernoulli.argtypes = [ctypes.c_void_p, f64p, ctypes.c_int64,
+                                    ctypes.c_double]
+    dll.bt_mt_randperm.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int64]
+    dll.bt_mt_get_state.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint32),
+                                    ctypes.POINTER(ctypes.c_int32), f64p,
+                                    ctypes.POINTER(ctypes.c_int32)]
+    dll.bt_mt_set_state.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint32),
+                                    ctypes.c_int32, ctypes.c_double,
+                                    ctypes.c_int32]
+    dll.bt_shard_index.restype = ctypes.c_int64
+    dll.bt_shard_index.argtypes = [ctypes.c_char_p, ctypes.c_int64, i64p, i64p,
+                                   ctypes.POINTER(ctypes.c_float),
+                                   ctypes.c_int64, ctypes.c_int32]
+    return dll
+
+
+class _Lib:
+    """Lazy handle: ``lib.crc32c`` etc. or ``None`` when unavailable."""
+
+    def __init__(self):
+        self._dll = None
+        self._tried = False
+
+    @property
+    def dll(self) -> ctypes.CDLL | None:
+        if not self._tried:
+            self._dll = _load()
+            self._tried = True
+        return self._dll
+
+    def __bool__(self) -> bool:
+        return self.dll is not None
+
+    # -- crc ------------------------------------------------------------ #
+    def crc32c(self, data: bytes, crc: int = 0) -> int:
+        return int(self.dll.bt_crc32c(data, len(data), crc))
+
+    # -- rng ------------------------------------------------------------ #
+    def mt_new(self, seed: int):
+        return self.dll.bt_mt_new(seed & 0xFFFFFFFFFFFFFFFF)
+
+    def mt_free(self, handle) -> None:
+        self.dll.bt_mt_free(handle)
+
+    def mt_set_seed(self, handle, seed: int) -> None:
+        self.dll.bt_mt_set_seed(handle, seed & 0xFFFFFFFFFFFFFFFF)
+
+    def mt_random(self, handle) -> float:
+        return float(self.dll.bt_mt_random(handle))
+
+    def mt_random_int(self, handle) -> int:
+        return int(self.dll.bt_mt_random_int(handle))
+
+    def mt_uniform(self, handle, n: int, a: float, b: float):
+        import numpy as np
+        out = np.empty(n, dtype=np.float64)
+        self.dll.bt_mt_uniform(handle, out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)), n, a, b)
+        return out
+
+    def mt_normal(self, handle, n: int, mean: float, stdv: float):
+        import numpy as np
+        out = np.empty(n, dtype=np.float64)
+        self.dll.bt_mt_normal(handle, out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)), n, mean, stdv)
+        return out
+
+    def mt_bernoulli(self, handle, n: int, p: float):
+        import numpy as np
+        out = np.empty(n, dtype=np.float64)
+        self.dll.bt_mt_bernoulli(handle, out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)), n, p)
+        return out
+
+    def mt_randperm(self, handle, n: int):
+        import numpy as np
+        out = np.empty(n, dtype=np.int64)
+        self.dll.bt_mt_randperm(handle, out.ctypes.data_as(
+            ctypes.POINTER(ctypes.c_int64)), n)
+        return out
+
+    def mt_get_state(self, handle):
+        mt = (ctypes.c_uint32 * 624)()
+        mti = ctypes.c_int32()
+        cached = ctypes.c_double()
+        has = ctypes.c_int32()
+        self.dll.bt_mt_get_state(handle, mt, ctypes.byref(mti),
+                                 ctypes.byref(cached), ctypes.byref(has))
+        return list(mt), mti.value, cached.value, has.value
+
+    def mt_set_state(self, handle, mt, mti, cached, has) -> None:
+        arr = (ctypes.c_uint32 * 624)(*[int(x) & 0xFFFFFFFF for x in mt])
+        self.dll.bt_mt_set_state(handle, arr, mti, cached, has)
+
+    # -- shard indexing -------------------------------------------------- #
+    def shard_index(self, buf, validate: bool = True):
+        """buf: bytes/memoryview of a whole shard file.  Returns
+        (offsets, lengths, labels) numpy arrays or raises ValueError."""
+        import numpy as np
+        data = bytes(buf)
+        # a record is >= 12 header bytes (payload may be empty)
+        max_n = max((len(data) - 5) // 12, 1)
+        offsets = np.empty(max_n, dtype=np.int64)
+        lengths = np.empty(max_n, dtype=np.int64)
+        labels = np.empty(max_n, dtype=np.float32)
+        n = self.dll.bt_shard_index(
+            data, len(data),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            max_n, 1 if validate else 0)
+        if n == -1:
+            raise ValueError("malformed record shard")
+        if n == -2:
+            raise ValueError("record shard crc mismatch")
+        if n == -3:  # cannot happen with the sizing above; defensive
+            raise ValueError("record shard index overflow")
+        return offsets[:n], lengths[:n], labels[:n]
+
+
+lib = _Lib()
+
+
+def get() -> _Lib | None:
+    """The single gating point callers should use: the loaded native
+    library, or None (pure-python fallbacks apply).  First call may build
+    the .so; subsequent calls are cached."""
+    return lib if lib.dll is not None else None

@@ -342,18 +342,15 @@ class Tensor:
         return self
 
     def rand(self) -> "Tensor":
-        vals = [RNG.uniform(0.0, 1.0) for _ in range(self.n_element())]
-        self._assign_flat(vals)
+        self._assign_flat(RNG.current().uniform_array(self.n_element()))
         return self
 
     def randn(self) -> "Tensor":
-        vals = [RNG.normal(0.0, 1.0) for _ in range(self.n_element())]
-        self._assign_flat(vals)
+        self._assign_flat(RNG.current().normal_array(self.n_element()))
         return self
 
     def bernoulli(self, p: float) -> "Tensor":
-        vals = [RNG.bernoulli(p) for _ in range(self.n_element())]
-        self._assign_flat(vals)
+        self._assign_flat(RNG.current().bernoulli_array(self.n_element(), p))
         return self
 
     def _assign_flat(self, vals) -> None:

@@ -85,14 +85,28 @@ class Engine:
     """Singleton runtime facade (ref utils/Engine.scala:84-99,142-146)."""
 
     @staticmethod
-    def init(node_number: Optional[int] = None, core_number: Optional[int] = None) -> None:
+    def init(node_number: Optional[int] = None, core_number: Optional[int] = None,
+             platform: Optional[str] = None) -> None:
         """Discover topology.  With no args: local mode uses the current
         process's devices (ref Engine.init no-arg, utils/Engine.scala:84-99);
         in a multi-host job call ``jax.distributed.initialize`` first (the
         analog of launching on Spark) and Engine picks up process/device
         counts from JAX.
+
+        ``platform`` (or the ``BIGDL_TPU_PLATFORM`` env var — Engine owns
+        env bootstrap like the reference's BIGDL_LOCAL_MODE/DL_CORE_NUMBER
+        contract, utils/Engine.scala:103-157) pins the JAX platform (e.g.
+        "cpu") before the first backend touch; useful when a sitecustomize
+        preselected an accelerator this process shouldn't use.
         """
         import jax
+
+        platform = platform or os.environ.get("BIGDL_TPU_PLATFORM")
+        if platform and jax.config.jax_platforms != platform:
+            try:
+                jax.config.update("jax_platforms", platform)
+            except RuntimeError:
+                pass  # backend already initialized; too late to switch
 
         with _state.lock:
             if node_number is None:

@@ -27,15 +27,40 @@ _UPPER_MASK = 0x80000000
 _LOWER_MASK = 0x7FFFFFFF
 
 
+def _native_lib():
+    """The C MT19937 backend (csrc/bigdl_tpu_native.cpp) or None."""
+    try:
+        from bigdl_tpu import native
+        return native.get()
+    except Exception:
+        return None
+
+
 class RandomGenerator:
     def __init__(self, seed: int = 5489):
         self._mt = np.zeros(_N, dtype=np.uint64)
         self._mti = _N + 1
         self._normal_cached = None
+        self._native = None  # C generator handle; same algorithm bit-for-bit
+        nl = _native_lib()
+        if nl is not None:
+            self._native = nl.mt_new(seed)
         self.set_seed(seed)
+
+    def __del__(self):
+        try:  # may run at interpreter shutdown with modules half-torn-down
+            if getattr(self, "_native", None) is not None:
+                nl = _native_lib()
+                if nl is not None:
+                    nl.mt_free(self._native)
+        except Exception:
+            pass
 
     def set_seed(self, seed: int) -> "RandomGenerator":
         self._seed = seed
+        if self._native is not None:
+            _native_lib().mt_set_seed(self._native, seed)
+            return self
         mt = self._mt
         mt[0] = seed & 0xFFFFFFFF
         for i in range(1, _N):
@@ -61,6 +86,8 @@ class RandomGenerator:
         self._mti = 0
 
     def _next_uint32(self) -> int:
+        if self._native is not None:
+            return _native_lib().mt_random_int(self._native)
         if self._mti >= _N:
             self._generate()
         y = int(self._mt[self._mti])
@@ -79,6 +106,8 @@ class RandomGenerator:
 
     def random(self) -> float:
         """53-bit double in [0,1)."""
+        if self._native is not None:
+            return _native_lib().mt_random(self._native)
         a = self._next_uint32() >> 5
         b = self._next_uint32() >> 6
         return (a * 67108864.0 + b) * (1.0 / 9007199254740992.0)
@@ -87,6 +116,8 @@ class RandomGenerator:
         return self.random() * (b - a) + a
 
     def normal(self, mean: float = 0.0, stdv: float = 1.0) -> float:
+        if self._native is not None:
+            return float(_native_lib().mt_normal(self._native, 1, mean, stdv)[0])
         if self._normal_cached is not None:
             v = self._normal_cached
             self._normal_cached = None
@@ -120,13 +151,24 @@ class RandomGenerator:
 
     # -- array helpers (for init parity tests) ----------------------------
     def uniform_array(self, n: int, a: float = 0.0, b: float = 1.0) -> np.ndarray:
+        if self._native is not None:
+            return _native_lib().mt_uniform(self._native, n, a, b)
         return np.array([self.uniform(a, b) for _ in range(n)])
 
     def normal_array(self, n: int, mean: float = 0.0, stdv: float = 1.0) -> np.ndarray:
+        if self._native is not None:
+            return _native_lib().mt_normal(self._native, n, mean, stdv)
         return np.array([self.normal(mean, stdv) for _ in range(n)])
+
+    def bernoulli_array(self, n: int, p: float) -> np.ndarray:
+        if self._native is not None:
+            return _native_lib().mt_bernoulli(self._native, n, p)
+        return np.array([1.0 if self.bernoulli(p) else 0.0 for _ in range(n)])
 
     def randperm(self, n: int) -> np.ndarray:
         """1-based random permutation (Torch randperm semantics)."""
+        if self._native is not None:
+            return _native_lib().mt_randperm(self._native, n)
         perm = np.arange(1, n + 1)
         for i in range(n - 1, 0, -1):
             j = int(self.random() * (i + 1))
@@ -135,11 +177,18 @@ class RandomGenerator:
 
 
 class _ThreadLocalRNG(threading.local):
-    def __init__(self):
-        self.gen = RandomGenerator()
+    gen: RandomGenerator = None  # created on first use, not at import
+    # (constructing a RandomGenerator may build/load the native library;
+    # keep module import free of that side effect)
 
 
 _tls = _ThreadLocalRNG()
+
+
+def _gen() -> RandomGenerator:
+    if _tls.gen is None:
+        _tls.gen = RandomGenerator()
+    return _tls.gen
 
 
 class RNG:
@@ -147,20 +196,20 @@ class RNG:
 
     @staticmethod
     def current() -> RandomGenerator:
-        return _tls.gen
+        return _gen()
 
     @staticmethod
     def set_seed(seed: int) -> None:
-        _tls.gen.set_seed(seed)
+        _gen().set_seed(seed)
 
     @staticmethod
     def uniform(a: float = 0.0, b: float = 1.0) -> float:
-        return _tls.gen.uniform(a, b)
+        return _gen().uniform(a, b)
 
     @staticmethod
     def normal(mean: float = 0.0, stdv: float = 1.0) -> float:
-        return _tls.gen.normal(mean, stdv)
+        return _gen().normal(mean, stdv)
 
     @staticmethod
     def bernoulli(p: float) -> bool:
-        return _tls.gen.bernoulli(p)
+        return _gen().bernoulli(p)

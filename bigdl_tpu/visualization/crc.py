@@ -34,8 +34,9 @@ def crc32c_py(data: bytes, crc: int = 0) -> int:
 
 def _load_native():
     try:
-        from bigdl_tpu.native import lib as _nl
-        return _nl.crc32c if _nl is not None and hasattr(_nl, "crc32c") else None
+        from bigdl_tpu import native
+        nl = native.get()
+        return nl.crc32c if nl is not None else None
     except Exception:
         return None
 

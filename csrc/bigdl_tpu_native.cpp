@@ -1,0 +1,241 @@
+// bigdl_tpu native runtime: host-side hot loops behind a C ABI (ctypes).
+//
+// Plays the role of the reference's native core library (BigDL-core JNI/MKL,
+// SURVEY.md §2.1) for the *runtime* half: on TPU the compute path is XLA,
+// but the host runtime — record framing CRCs (ref netty/Crc32c.java),
+// Torch-compatible MT19937 bulk random generation (ref
+// utils/RandomGenerator.scala:23-265), and record-shard indexing for the
+// data loader (the SequenceFile-reader role, ref dataset/DataSet.scala
+// :380-433) — stays on the CPU and benefits from native code.
+//
+// Build: g++ -O3 -fPIC -shared -o libbigdl_tpu_native.so bigdl_tpu_native.cpp
+// No external dependencies.
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <cstdlib>
+
+extern "C" {
+
+// --------------------------------------------------------------------- //
+// CRC32C (Castagnoli, reflected poly 0x82F63B78), slice-by-8            //
+// --------------------------------------------------------------------- //
+
+static uint32_t g_crc_table[8][256];
+static bool g_crc_init = false;
+
+static void crc_init_tables() {
+    for (int n = 0; n < 256; ++n) {
+        uint32_t c = (uint32_t)n;
+        for (int k = 0; k < 8; ++k)
+            c = (c & 1) ? (c >> 1) ^ 0x82F63B78u : c >> 1;
+        g_crc_table[0][n] = c;
+    }
+    for (int n = 0; n < 256; ++n) {
+        uint32_t c = g_crc_table[0][n];
+        for (int s = 1; s < 8; ++s) {
+            c = g_crc_table[0][c & 0xFF] ^ (c >> 8);
+            g_crc_table[s][n] = c;
+        }
+    }
+    g_crc_init = true;
+}
+
+uint32_t bt_crc32c(const uint8_t* data, int64_t len, uint32_t crc) {
+    if (!g_crc_init) crc_init_tables();
+    crc ^= 0xFFFFFFFFu;
+    // align-friendly 8-byte slices
+    while (len >= 8) {
+        uint64_t chunk;
+        std::memcpy(&chunk, data, 8);
+        crc ^= (uint32_t)chunk;
+        uint32_t hi = (uint32_t)(chunk >> 32);
+        crc = g_crc_table[7][crc & 0xFF] ^ g_crc_table[6][(crc >> 8) & 0xFF] ^
+              g_crc_table[5][(crc >> 16) & 0xFF] ^ g_crc_table[4][crc >> 24] ^
+              g_crc_table[3][hi & 0xFF] ^ g_crc_table[2][(hi >> 8) & 0xFF] ^
+              g_crc_table[1][(hi >> 16) & 0xFF] ^ g_crc_table[0][hi >> 24];
+        data += 8;
+        len -= 8;
+    }
+    while (len-- > 0)
+        crc = g_crc_table[0][(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
+// --------------------------------------------------------------------- //
+// Torch-compatible MT19937 (N=624, M=397) with 53-bit doubles and       //
+// polar-method normals (one cached), matching bigdl_tpu.utils.rng       //
+// --------------------------------------------------------------------- //
+
+struct BtMt {
+    uint32_t mt[624];
+    int mti;
+    double cached;
+    int has_cached;
+};
+
+static void mt_seed(BtMt* g, uint64_t seed) {
+    g->mt[0] = (uint32_t)(seed & 0xFFFFFFFFu);
+    for (int i = 1; i < 624; ++i)
+        g->mt[i] = 1812433253u * (g->mt[i - 1] ^ (g->mt[i - 1] >> 30)) + (uint32_t)i;
+    g->mti = 624;
+    g->has_cached = 0;
+}
+
+void* bt_mt_new(uint64_t seed) {
+    BtMt* g = (BtMt*)std::malloc(sizeof(BtMt));
+    mt_seed(g, seed);
+    return g;
+}
+
+void bt_mt_free(void* p) { std::free(p); }
+
+void bt_mt_set_seed(void* p, uint64_t seed) { mt_seed((BtMt*)p, seed); }
+
+static inline uint32_t mt_next(BtMt* g) {
+    if (g->mti >= 624) {
+        uint32_t* mt = g->mt;
+        for (int i = 0; i < 624; ++i) {
+            uint32_t y = (mt[i] & 0x80000000u) | (mt[(i + 1) % 624] & 0x7FFFFFFFu);
+            mt[i] = mt[(i + 397) % 624] ^ (y >> 1) ^ ((y & 1u) ? 0x9908B0DFu : 0u);
+        }
+        g->mti = 0;
+    }
+    uint32_t y = g->mt[g->mti++];
+    y ^= y >> 11;
+    y ^= (y << 7) & 0x9D2C5680u;
+    y ^= (y << 15) & 0xEFC60000u;
+    y ^= y >> 18;
+    return y;
+}
+
+static inline double mt_random(BtMt* g) {  // 53-bit double in [0,1)
+    uint32_t a = mt_next(g) >> 5, b = mt_next(g) >> 6;
+    return (a * 67108864.0 + b) * (1.0 / 9007199254740992.0);
+}
+
+double bt_mt_random(void* p) { return mt_random((BtMt*)p); }
+
+uint32_t bt_mt_random_int(void* p) { return mt_next((BtMt*)p); }
+
+void bt_mt_uniform(void* p, double* out, int64_t n, double a, double b) {
+    BtMt* g = (BtMt*)p;
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = mt_random(g) * (b - a) + a;
+}
+
+static inline double mt_normal(BtMt* g) {
+    if (g->has_cached) {
+        g->has_cached = 0;
+        return g->cached;
+    }
+    double u, v, s;
+    do {
+        u = 2.0 * mt_random(g) - 1.0;
+        v = 2.0 * mt_random(g) - 1.0;
+        s = u * u + v * v;
+    } while (s >= 1.0 || s <= 0.0);
+    double mult = std::sqrt(-2.0 * std::log(s) / s);
+    g->cached = v * mult;
+    g->has_cached = 1;
+    return u * mult;
+}
+
+void bt_mt_normal(void* p, double* out, int64_t n, double mean, double stdv) {
+    BtMt* g = (BtMt*)p;
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = mean + stdv * mt_normal(g);
+}
+
+void bt_mt_bernoulli(void* p, double* out, int64_t n, double prob) {
+    BtMt* g = (BtMt*)p;
+    for (int64_t i = 0; i < n; ++i)
+        out[i] = (mt_random(g) <= prob) ? 1.0 : 0.0;
+}
+
+void bt_mt_randperm(void* p, int64_t* out, int64_t n) {
+    BtMt* g = (BtMt*)p;
+    for (int64_t i = 0; i < n; ++i) out[i] = i + 1;  // 1-based, Torch style
+    for (int64_t i = n - 1; i > 0; --i) {
+        int64_t j = (int64_t)(mt_random(g) * (double)(i + 1));
+        int64_t t = out[i]; out[i] = out[j]; out[j] = t;
+    }
+}
+
+// state round-trip so the Python generator can hand off / resume exactly
+void bt_mt_get_state(void* p, uint32_t* mt, int32_t* mti, double* cached,
+                     int32_t* has_cached) {
+    BtMt* g = (BtMt*)p;
+    std::memcpy(mt, g->mt, sizeof(g->mt));
+    *mti = g->mti;
+    *cached = g->cached;
+    *has_cached = g->has_cached;
+}
+
+void bt_mt_set_state(void* p, const uint32_t* mt, int32_t mti, double cached,
+                     int32_t has_cached) {
+    BtMt* g = (BtMt*)p;
+    std::memcpy(g->mt, mt, sizeof(g->mt));
+    g->mti = mti;
+    g->cached = cached;
+    g->has_cached = has_cached;
+}
+
+// --------------------------------------------------------------------- //
+// Record-shard indexer: one pass over an in-memory (mmapped) shard,     //
+// emitting per-record payload offsets/lengths/labels.  Format (LE):     //
+//   "BTRS\x01" | { u32 len | u32 crc32 (zlib) | f32 label | payload }*  //
+// --------------------------------------------------------------------- //
+
+// zlib-style CRC32 (reflected poly 0xEDB88320) for shard payload checks
+static uint32_t g_z_table[256];
+static bool g_z_init = false;
+
+static void z_init_table() {
+    for (int n = 0; n < 256; ++n) {
+        uint32_t c = (uint32_t)n;
+        for (int k = 0; k < 8; ++k)
+            c = (c & 1) ? (c >> 1) ^ 0xEDB88320u : c >> 1;
+        g_z_table[n] = c;
+    }
+    g_z_init = true;
+}
+
+uint32_t bt_crc32(const uint8_t* data, int64_t len, uint32_t crc) {
+    if (!g_z_init) z_init_table();
+    crc ^= 0xFFFFFFFFu;
+    while (len-- > 0)
+        crc = g_z_table[(crc ^ *data++) & 0xFF] ^ (crc >> 8);
+    return crc ^ 0xFFFFFFFFu;
+}
+
+// returns record count, or -1 on malformed input / -2 on crc mismatch /
+// -3 when max_n was reached with data left (caller sized arrays too small)
+int64_t bt_shard_index(const uint8_t* buf, int64_t len, int64_t* offsets,
+                       int64_t* lengths, float* labels, int64_t max_n,
+                       int32_t validate) {
+    const int64_t kMagic = 5;
+    if (len < kMagic || std::memcmp(buf, "BTRS\x01", kMagic) != 0) return -1;
+    int64_t pos = kMagic, n = 0;
+    while (pos < len) {
+        if (n >= max_n) return -3;
+        if (pos + 12 > len) return -1;  // truncated header
+        uint32_t plen, crc;
+        float label;
+        std::memcpy(&plen, buf + pos, 4);
+        std::memcpy(&crc, buf + pos + 4, 4);
+        std::memcpy(&label, buf + pos + 8, 4);
+        pos += 12;
+        if (pos + (int64_t)plen > len) return -1;  // truncated payload
+        if (validate && bt_crc32(buf + pos, plen, 0) != crc) return -2;
+        offsets[n] = pos;
+        lengths[n] = plen;
+        labels[n] = label;
+        pos += plen;
+        ++n;
+    }
+    return n;
+}
+
+}  // extern "C"

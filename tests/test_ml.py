@@ -1,0 +1,90 @@
+"""DLClassifier / DLModel / ModelBroadcast tests
+(ref org/apache/spark/ml/DLClassifier.scala, models/utils/ModelBroadcast.scala)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.ml import DLClassifier, DLModel, ModelBroadcast
+
+
+@pytest.fixture(scope="module")
+def trained_linear():
+    """A 4->3 classifier whose argmax is feature-block determined."""
+    model = nn.Sequential(nn.Linear(4, 3), nn.LogSoftMax()).build(seed=1)
+    w = np.zeros((3, 4), np.float32)
+    w[0, 0] = w[1, 1] = w[2, 2] = 5.0
+    model.params["0"]["weight"] = np.asarray(w)
+    model.params["0"]["bias"] = np.zeros(3, np.float32)
+    return model
+
+
+def _feature(cls: int) -> np.ndarray:
+    x = np.zeros(4, np.float32)
+    x[cls] = 1.0
+    return x
+
+
+class TestDLModel:
+    def test_predict_shapes(self, trained_linear):
+        m = DLModel(trained_linear, (2, 4))
+        out = m.predict(np.stack([_feature(0), _feature(1), _feature(2)]))
+        assert out.shape == (3, 3)
+
+    def test_predict_class_one_based(self, trained_linear):
+        m = DLClassifier(trained_linear, (2, 4))
+        pred = m.predict_class(np.stack([_feature(0), _feature(1), _feature(2)]))
+        assert pred.tolist() == [1, 2, 3]
+
+    def test_tail_batch_padding(self, trained_linear):
+        m = DLClassifier(trained_linear, (4, 4))
+        feats = np.stack([_feature(i % 3) for i in range(7)])  # 7 % 4 != 0
+        pred = m.predict_class(feats)
+        assert pred.tolist() == [1, 2, 3, 1, 2, 3, 1]
+
+    def test_empty_input(self, trained_linear):
+        m = DLClassifier(trained_linear, (2, 4))
+        assert m.predict(np.empty((0, 4), np.float32)).shape[0] == 0
+        assert m.predict_class(np.empty((0, 4), np.float32)).shape == (0,)
+
+    def test_samples_input(self, trained_linear):
+        from bigdl_tpu.dataset.types import Sample
+        m = DLClassifier(trained_linear, (2, 4))
+        samples = [Sample(_feature(2), np.float32(3.0))]
+        assert m.predict_class(samples).tolist() == [3]
+
+    def test_reshape_flat_rows(self, trained_linear):
+        """Rows arriving flat are reshaped to the model's feature shape."""
+        m = DLClassifier(trained_linear, (2, 4))
+        pred = m.predict_class([_feature(1).tolist()])
+        assert pred.tolist() == [2]
+
+
+class TestTransform:
+    def test_dataframe_transform(self, trained_linear):
+        pd = pytest.importorskip("pandas")
+        df = pd.DataFrame({"features": [_feature(0), _feature(2)]})
+        out = DLClassifier(trained_linear, (2, 4)).transform(df)
+        assert out["prediction"].tolist() == [1.0, 3.0]
+        assert "features" in out.columns  # original columns preserved
+
+
+class TestModelBroadcast:
+    def test_broadcast_value_predicts(self, trained_linear):
+        bc = ModelBroadcast(trained_linear)
+        rebuilt = bc.value()
+        m = DLClassifier(rebuilt, (2, 4))
+        assert m.predict_class(np.stack([_feature(1)])).tolist() == [2]
+
+    def test_original_model_untouched(self, trained_linear):
+        bc = ModelBroadcast(trained_linear)
+        assert trained_linear.params is not None
+        out1 = DLClassifier(trained_linear, (2, 4)).predict(
+            np.stack([_feature(0)]))
+        out2 = DLClassifier(bc.value(), (2, 4)).predict(np.stack([_feature(0)]))
+        np.testing.assert_allclose(out1, out2)
+
+    def test_structure_shared_weights_not_copied_twice(self, trained_linear):
+        bc = ModelBroadcast(trained_linear)
+        v1, v2 = bc.value(), bc.value()
+        # weights are the broadcast arrays, shared, not per-value copies
+        assert v1.params["0"]["weight"] is v2.params["0"]["weight"]

@@ -1,0 +1,172 @@
+"""Native C++ runtime library tests: build, and bit-parity with the pure
+python fallbacks (the reference gates on MKL.isMKLLoaded the same way,
+tensor/TensorNumeric.scala:297-316)."""
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import native
+from bigdl_tpu.utils.rng import RandomGenerator
+from bigdl_tpu.visualization.crc import crc32c_py, masked_crc32c
+
+
+def _python_generator(seed: int) -> RandomGenerator:
+    """A RandomGenerator forced onto the pure-python path."""
+    g = RandomGenerator.__new__(RandomGenerator)
+    g._mt = np.zeros(624, dtype=np.uint64)
+    g._mti = 625
+    g._normal_cached = None
+    g._native = None
+    g.set_seed(seed)
+    return g
+
+
+needs_native = pytest.mark.skipif(
+    native.lib is None or native.lib.dll is None,
+    reason="native library unavailable (no g++?)")
+
+
+@needs_native
+class TestNativeBuilds:
+    def test_so_exists(self):
+        assert native.lib.dll is not None
+
+
+@needs_native
+class TestCrc:
+    def test_crc32c_vectors(self):
+        # RFC 3720 test vector: 32 zero bytes -> 0x8A9136AA
+        assert native.lib.crc32c(b"\x00" * 32) == 0x8A9136AA
+        assert native.lib.crc32c(b"123456789") == 0xE3069283
+
+    def test_crc32c_matches_python(self):
+        rng = np.random.RandomState(0)
+        for n in [0, 1, 7, 8, 9, 63, 64, 1000]:
+            data = rng.bytes(n)
+            assert native.lib.crc32c(data) == crc32c_py(data)
+
+    def test_masked_crc_roundtrip(self):
+        # masked_crc32c dispatches to native when built; sanity vs python
+        data = b"tfevents payload"
+        crc = crc32c_py(data)
+        expect = (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF
+        assert masked_crc32c(data) == expect
+
+
+@needs_native
+class TestRngParity:
+    def test_random_sequence(self):
+        nat = RandomGenerator(42)
+        if nat._native is None:
+            pytest.skip("native rng not active")
+        py = _python_generator(42)
+        for _ in range(100):
+            assert nat.random() == py.random()
+
+    def test_uniform_normal_arrays(self):
+        nat = RandomGenerator(7)
+        if nat._native is None:
+            pytest.skip("native rng not active")
+        py = _python_generator(7)
+        np.testing.assert_array_equal(nat.uniform_array(64, -1, 1),
+                                      py.uniform_array(64, -1, 1))
+        np.testing.assert_array_equal(nat.normal_array(65, 2.0, 3.0),
+                                      py.normal_array(65, 2.0, 3.0))
+
+    def test_normal_cache_interleave(self):
+        """Scalar normal() must consume the polar-method cache identically."""
+        nat = RandomGenerator(5)
+        if nat._native is None:
+            pytest.skip("native rng not active")
+        py = _python_generator(5)
+        for _ in range(11):  # odd count exercises the cached second value
+            assert nat.normal() == py.normal()
+        # and the stream stays aligned afterwards
+        assert nat.random() == py.random()
+
+    def test_randperm_parity(self):
+        nat = RandomGenerator(13)
+        if nat._native is None:
+            pytest.skip("native rng not active")
+        py = _python_generator(13)
+        np.testing.assert_array_equal(nat.randperm(50), py.randperm(50))
+
+    def test_random_int_parity(self):
+        nat = RandomGenerator(99)
+        if nat._native is None:
+            pytest.skip("native rng not active")
+        py = _python_generator(99)
+        for _ in range(10):
+            assert nat.random_int() == py.random_int()
+
+    def test_state_roundtrip(self):
+        g1 = RandomGenerator(3)
+        if g1._native is None:
+            pytest.skip("native rng not active")
+        lib = native.lib
+        g1.normal()  # populate the cache
+        state = lib.mt_get_state(g1._native)
+        expect = [g1.random() for _ in range(5)]
+        g2 = RandomGenerator(999)
+        lib.mt_set_state(g2._native, *state)
+        assert [g2.random() for _ in range(5)] == expect
+
+
+@needs_native
+class TestShardIndex:
+    def test_roundtrip(self, tmp_path):
+        from bigdl_tpu.dataset.seqfile import read_shard, write_shard
+        from bigdl_tpu.dataset.types import ByteRecord
+
+        rng = np.random.RandomState(1)
+        records = [ByteRecord(rng.bytes(int(rng.randint(1, 200))), float(i))
+                   for i in range(20)]
+        path = str(tmp_path / "shard-0")
+        assert write_shard(path, records) == 20
+        back = list(read_shard(path))
+        assert len(back) == 20
+        for a, b in zip(records, back):
+            assert a.data == b.data and a.label == b.label
+
+    def test_empty_payload_records_not_dropped(self, tmp_path):
+        """13 empty-payload records are 12 bytes each; the index sizing
+        must not truncate them (regression: max_n was len//13)."""
+        from bigdl_tpu.dataset.seqfile import read_shard, write_shard
+        from bigdl_tpu.dataset.types import ByteRecord
+
+        path = str(tmp_path / "shard-empty")
+        write_shard(path, [ByteRecord(b"", float(i)) for i in range(13)])
+        back = list(read_shard(path))
+        assert len(back) == 13
+        assert [r.label for r in back] == [float(i) for i in range(13)]
+
+    def test_crc_detection(self, tmp_path):
+        from bigdl_tpu.dataset.seqfile import read_shard, write_shard
+        from bigdl_tpu.dataset.types import ByteRecord
+
+        path = str(tmp_path / "shard-bad")
+        write_shard(path, [ByteRecord(b"x" * 50, 1.0)])
+        raw = bytearray(open(path, "rb").read())
+        raw[-10] ^= 0xFF  # corrupt payload
+        open(path, "wb").write(bytes(raw))
+        with pytest.raises(ValueError):
+            list(read_shard(path))
+
+    def test_native_index_direct(self, tmp_path):
+        from bigdl_tpu.dataset.seqfile import write_shard
+        from bigdl_tpu.dataset.types import ByteRecord
+
+        path = str(tmp_path / "shard-1")
+        write_shard(path, [ByteRecord(b"abc", 2.0), ByteRecord(b"defg", 3.0)])
+        buf = open(path, "rb").read()
+        offsets, lengths, labels = native.lib.shard_index(buf)
+        assert list(lengths) == [3, 4]
+        assert list(labels) == [2.0, 3.0]
+        assert buf[offsets[0]:offsets[0] + 3] == b"abc"
+
+    def test_zlib_crc_matches(self):
+        data = b"hello shard"
+        assert native.lib.dll.bt_crc32(data, len(data), 0) == \
+            (zlib.crc32(data) & 0xFFFFFFFF)
