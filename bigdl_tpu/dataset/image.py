@@ -73,6 +73,16 @@ class LocalImgReader(Transformer):
         return LabeledImage(np.ascontiguousarray(bgr.transpose(2, 0, 1)), float(label))
 
 
+class GreyFromBGR(Transformer):
+    """(3,H,W) BGR -> (1,H,W) luminance, for feeding colour files to
+    grey-input models (BT.601 weights)."""
+
+    def transform_one(self, img: LabeledImage) -> LabeledImage:
+        b, g, r = img.data[0], img.data[1], img.data[2]
+        grey = 0.114 * b + 0.587 * g + 0.299 * r
+        return LabeledImage(grey[None].astype(np.float32), img.label)
+
+
 class GreyImgNormalizer(Transformer):
     """(x - mean) / std (ref dataset/image/GreyImgNormalizer.scala).
     Construct with explicit stats, or ``fit`` over a dataset."""

@@ -1,0 +1,4 @@
+"""End-to-end example programs (ref spark/dl/.../example/): image
+classification with a trained model, validating imported models, and text
+classification (the latter lives at bigdl_tpu.models.textclassifier.train).
+"""

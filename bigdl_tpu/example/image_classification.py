@@ -1,0 +1,93 @@
+"""Image-classification predictor example
+(ref example/imageclassification/ImagePredictor.scala: broadcast a trained
+model and map batched forwards over an image DataFrame via DLClassifier).
+
+    python -m bigdl_tpu.example.image_classification \
+        --model lenet.bin --folder ./images --modelType lenet
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Predict classes for an image folder")
+    p.add_argument("--model", required=True, help="trained model file")
+    p.add_argument("-f", "--folder", required=True,
+                   help="image dir: <folder>/<class>/<img> or flat files")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--modelType", default="imagenet",
+                   choices=["imagenet", "lenet", "cifar10"],
+                   help="selects the preprocessing pipeline")
+    p.add_argument("--topN", type=int, default=1)
+    return p
+
+
+def _pipeline(model_type: str):
+    from bigdl_tpu.dataset import image
+
+    if model_type == "lenet":
+        from bigdl_tpu.dataset import mnist
+        return (image.LocalImgReader(scale_to=28) >> image.GreyFromBGR()
+                >> image.GreyImgCropper(28, 28)
+                >> image.GreyImgNormalizer(mnist.TRAIN_MEAN,
+                                           mnist.TRAIN_STD)), (1, 28, 28)
+    if model_type == "cifar10":
+        from bigdl_tpu.dataset import cifar
+        return (image.LocalImgReader(scale_to=32)
+                >> image.BGRImgCropper(32, 32)
+                >> image.BGRImgNormalizer(cifar.TRAIN_MEAN, cifar.TRAIN_STD)), (3, 32, 32)
+    return (image.LocalImgReader(scale_to=256)
+            >> image.BGRImgCropper(224, 224)
+            >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0))), (3, 224, 224)
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    import os
+
+    import numpy as np
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.ml import DLClassifier
+
+    Engine.init()
+    # accept both <folder>/<class>/<img> layouts and flat image dirs
+    img_exts = (".png", ".jpg", ".jpeg", ".bmp", ".gif", ".ppm", ".pgm")
+
+    def is_image(name: str) -> bool:
+        return name.lower().endswith(img_exts)
+
+    root = args.folder
+    entries = sorted(os.listdir(root))
+    if any(os.path.isdir(os.path.join(root, e)) for e in entries):
+        records = []
+        for li, cls in enumerate(
+                (e for e in entries if os.path.isdir(os.path.join(root, e))),
+                start=1):
+            d = os.path.join(root, cls)
+            records.extend((os.path.join(d, f), float(li))
+                           for f in sorted(os.listdir(d)) if is_image(f))
+    else:
+        records = [(os.path.join(root, f), 0.0) for f in entries
+                   if is_image(f)]
+    if not records:
+        raise SystemExit(f"no image files found under {root}")
+
+    pipe, feat_shape = _pipeline(args.modelType)
+    images = list(pipe(iter(records)))
+    feats = np.stack([img.data for img in images])
+
+    model = nn.Module.load(args.model)
+    clf = DLClassifier(model, (args.batchSize, *feat_shape))
+    out = clf.predict(feats)
+    top = np.argsort(-out, axis=-1)[:, :args.topN] + 1  # 1-based classes
+    for (path, _), classes in zip(records, top):
+        print(f"{path}: {' '.join(str(int(c)) for c in classes)}")
+
+
+if __name__ == "__main__":
+    main()

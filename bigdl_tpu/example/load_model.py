@@ -1,0 +1,108 @@
+"""Model-validator example (ref example/loadmodel/ModelValidator.scala:
+load a native / Torch .t7 / Caffe model and evaluate Top1+Top5 on an
+image dataset).
+
+    python -m bigdl_tpu.example.load_model --modelType bigdl \
+        --model lenet.bin -f ./mnist --dataset mnist
+    python -m bigdl_tpu.example.load_model --modelType caffe \
+        --caffeDefPath deploy.prototxt --model net.caffemodel \
+        --modelFactory alexnet -f ./shards
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Load + validate a model")
+    p.add_argument("--modelType", required=True,
+                   choices=["bigdl", "torch", "caffe"])
+    p.add_argument("--model", required=True,
+                   help="model file (.bin / .t7 / .caffemodel)")
+    p.add_argument("--caffeDefPath", default=None, help="prototxt (caffe)")
+    p.add_argument("--modelFactory", default=None,
+                   help="factory to build the skeleton for caffe weight "
+                        "copy: lenet|alexnet|inception_v1|vgg16|resnet50")
+    p.add_argument("-f", "--folder", required=True, help="data dir")
+    p.add_argument("--dataset", default="mnist",
+                   choices=["mnist", "cifar10", "imagenet"])
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    return p
+
+
+def _build_skeleton(name: str):
+    from bigdl_tpu.models.alexnet import AlexNet
+    from bigdl_tpu.models.inception import Inception_v1
+    from bigdl_tpu.models.lenet import LeNet5
+    from bigdl_tpu.models.resnet import ResNet
+    from bigdl_tpu.models.vgg import Vgg_16
+
+    factories = {
+        "lenet": lambda: LeNet5(10),
+        "alexnet": lambda: AlexNet(1000),
+        "inception_v1": lambda: Inception_v1(1000),
+        "vgg16": lambda: Vgg_16(1000),
+        "resnet50": lambda: ResNet(1000, depth=50, dataset="imagenet"),
+    }
+    if name not in factories:
+        raise SystemExit(f"--modelFactory must be one of {sorted(factories)}")
+    return factories[name]().build(seed=1)
+
+
+def load_model(args):
+    from bigdl_tpu import nn
+
+    if args.modelType == "bigdl":
+        return nn.Module.load(args.model)
+    if args.modelType == "torch":
+        return nn.Module.load_torch(args.model)
+    if not args.caffeDefPath or not args.modelFactory:
+        raise SystemExit("caffe loading needs --caffeDefPath and --modelFactory")
+    model = _build_skeleton(args.modelFactory)
+    return model.load_caffe(args.caffeDefPath, args.model)
+
+
+def _dataset(args):
+    from bigdl_tpu.dataset import DataSet, image
+
+    if args.dataset == "mnist":
+        from bigdl_tpu.dataset import mnist
+        records = mnist.load(args.folder, train=False)
+        return DataSet.array(records) >> (
+            image.BytesToGreyImg(28, 28)
+            >> image.GreyImgNormalizer(mnist.TEST_MEAN, mnist.TEST_STD)
+            >> image.GreyImgToBatch(args.batchSize))
+    if args.dataset == "cifar10":
+        from bigdl_tpu.dataset import cifar
+        records = cifar.load(args.folder, train=False)
+        return DataSet.array(records) >> (
+            image.BGRImgNormalizer(cifar.TRAIN_MEAN, cifar.TRAIN_STD)
+            >> image.BGRImgToBatch(args.batchSize))
+    import glob
+    import os
+    shards = sorted(glob.glob(os.path.join(args.folder, "*")))
+    val = [s for s in shards if "val" in os.path.basename(s)] or shards
+    return DataSet.record_files(val) >> image.MTLabeledBGRImgToBatch(
+        224, 224, args.batchSize,
+        image.BytesToBGRImg() >> image.BGRImgCropper(224, 224)
+        >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine
+    from bigdl_tpu.optim import LocalValidator, Top1Accuracy, Top5Accuracy
+
+    Engine.init()
+    model = load_model(args)
+    ds = _dataset(args)
+    for method, result in LocalValidator(model, ds).test(
+            [Top1Accuracy(), Top5Accuracy()]):
+        print(f"{method} is {result}")
+
+
+if __name__ == "__main__":
+    main()

@@ -126,7 +126,8 @@ class MultiHeadAttention(Module):
 
     def __init__(self, hidden_size: int, n_head: int,
                  head_dim: Optional[int] = None, causal: bool = False,
-                 with_bias: bool = True, block_size: Optional[int] = None):
+                 with_bias: bool = True, block_size: Optional[int] = None,
+                 attention_impl: str = "auto"):
         super().__init__()
         assert head_dim is not None or hidden_size % n_head == 0
         self.hidden_size = hidden_size
@@ -135,6 +136,12 @@ class MultiHeadAttention(Module):
         self.causal = causal
         self.with_bias = with_bias
         self.block_size = block_size  # None -> plain fused attention
+        # "auto": plain/blockwise by block_size; "flash": the Pallas kernel
+        # (bigdl_tpu.ops.flash_attention) — the TPU hot path
+        if attention_impl not in ("auto", "flash"):
+            raise ValueError(f"attention_impl must be 'auto' or 'flash', "
+                             f"got {attention_impl!r}")
+        self.attention_impl = attention_impl
 
     def init(self, rng):
         ks = jax.random.split(rng, 4)
@@ -184,7 +191,12 @@ class MultiHeadAttention(Module):
         else:
             q_in = k_in = v_in = x
         q, k, v = self.project_qkv(params, q_in, k_in, v_in)
-        if self.block_size:
+        if self.attention_impl == "flash":
+            from bigdl_tpu.ops import flash_attention
+            bs = self.block_size or 128
+            o = flash_attention(q, k, v, causal=self.causal,
+                                block_q=bs, block_k=bs)
+        elif self.block_size:
             o = blockwise_attention(q, k, v, block_size=self.block_size,
                                     causal=self.causal)
         else:

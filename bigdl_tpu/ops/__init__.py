@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference backs its hot loops with a native library (SURVEY.md §2.1);
+on TPU XLA fusion covers most of that role, and this package holds the
+kernels where explicit control over VMEM/MXU tiling beats XLA's default
+schedule.  Every op has a pure-XLA fallback; kernels run in interpreter
+mode off-TPU so the test suite exercises them on CPU.
+"""
+from bigdl_tpu.ops.flash_attention import flash_attention  # noqa: F401

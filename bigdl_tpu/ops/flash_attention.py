@@ -1,0 +1,197 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Forward is a tiled online-softmax kernel over a (B, H, n_q, n_k) grid: the
+innermost grid dimension streams (block_k, d) K/V tiles from HBM through
+VMEM while per-q-block accumulators (acc, m, l) live in VMEM scratch, so
+neither the (T, T) score matrix nor the full K/V ever needs to be resident
+— sequence length is bounded by HBM, not VMEM.  Causal and padded key
+blocks are skipped with predicated execution.  Backward recomputes
+probabilities from the saved logsumexp — the standard flash recomputation
+— as one fused XLA expression.
+
+Cross-attention (Tq != Tk) aligns causality bottom-right (query i attends
+key j iff j - Tk <= i - Tq), matching ``dot_product_attention``.
+
+Capability-gap fill: the reference predates attention entirely
+(SURVEY.md §5.7); this is the single-chip hot path under
+``MultiHeadAttention`` and composes with the ring/Ulysses sequence
+parallelism in ``bigdl_tpu.parallel.sequence``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG = -1e30  # large-negative mask value: avoids (-inf) - (-inf) NaNs
+_LANES = 128  # m/l scratch is kept lane-replicated for TPU-friendly tiles
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *,
+                scale: float, causal: bool, tq_real: int, tk_real: int,
+                block_q: int, block_k: int):
+    iq = pl.program_id(2)
+    j = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    d = q_ref.shape[3]
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros((block_q, d), jnp.float32)
+        m_ref[:] = jnp.full((block_q, _LANES), _NEG, jnp.float32)
+        l_ref[:] = jnp.zeros((block_q, _LANES), jnp.float32)
+
+    # bottom-right causal alignment: query row r has global causal
+    # position iq*block_q + r + (tk_real - tq_real)
+    q_end = iq * block_q + block_q - 1 + (tk_real - tq_real)
+    block_live = jnp.logical_and(
+        j * block_k < tk_real,                      # not pure key padding
+        jnp.logical_or(not causal, j * block_k <= q_end))
+
+    @pl.when(block_live)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < tk_real
+        if causal:
+            q_pos = iq * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0) + (tk_real - tq_real)
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, (block_q, _LANES))
+        l_ref[:] = jnp.broadcast_to(l_new, (block_q, _LANES))
+
+    @pl.when(j == n_k - 1)
+    def _():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_ref[:, 0] + jnp.log(l_safe[:, 0])).astype(
+            jnp.float32)
+
+
+def _pad_t(x, block):
+    t = x.shape[2]
+    rem = t % block
+    if rem == 0:
+        return x
+    return jnp.pad(x, [(0, 0), (0, 0), (0, block - rem), (0, 0)])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    qp = _pad_t(q, block_q)
+    kp = _pad_t(k, block_k)
+    vp = _pad_t(v, block_k)
+    tq_pad, tk_pad = qp.shape[2], kp.shape[2]
+    n_q, n_k = tq_pad // block_q, tk_pad // block_k
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, tq_real=tq, tk_real=tk,
+        block_q=block_q, block_k=block_k)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(b, h, n_q, n_k),  # j innermost: scratch accumulates over it
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),       # acc
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :, :tq], lse[:, :, :tq]
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, scale, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                      _use_interpret())
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                        _use_interpret())
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    # standard flash backward: recompute P from q,k and the saved
+    # logsumexp, then one fused XLA expression (per-block pallas backward
+    # is a later optimization; XLA already tiles these matmuls)
+    q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
+    do32, o32 = do.astype(jnp.float32), o.astype(jnp.float32)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
+    if causal:  # bottom-right alignment, same as the forward kernel
+        tq, tk = q.shape[2], k.shape[2]
+        cmask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
+        s = jnp.where(cmask, s, _NEG)
+    p = jnp.exp(s - lse[..., None])
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v32)
+    delta = jnp.sum(do32 * o32, axis=-1)
+    ds = p * (dp - delta[..., None])
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k32) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Tiled flash attention.  q: (B, H, Tq, D); k, v: (B, H, Tk, D) — D
+    should be a multiple of 128 for MXU-aligned tiles (smaller D works at
+    reduced efficiency).  Runs the Pallas kernel on TPU, interpreter mode
+    elsewhere; differentiable via the recomputation backward."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash(q, k, v, causal, float(scale),
+                  int(block_q), int(block_k))

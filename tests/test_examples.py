@@ -1,0 +1,105 @@
+"""End-to-end example program tests (ref example/imageclassification/
+ImagePredictor.scala, example/loadmodel/ModelValidator.scala)."""
+import os
+import struct
+
+import numpy as np
+import pytest
+
+
+def _write_mnist_idx(folder, n=32, train=False):
+    """Tiny valid IDX pair with a learnable label<->pixel pattern."""
+    prefix = "train" if train else "t10k"
+    rng = np.random.RandomState(0)
+    images = rng.randint(0, 50, size=(n, 28, 28)).astype(np.uint8)
+    labels = (np.arange(n) % 10).astype(np.uint8)
+    for i in range(n):
+        images[i, labels[i] * 2:labels[i] * 2 + 3, :] += 150
+    with open(os.path.join(folder, f"{prefix}-images-idx3-ubyte"), "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with open(os.path.join(folder, f"{prefix}-labels-idx1-ubyte"), "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return images, labels
+
+
+@pytest.fixture(scope="module")
+def lenet_file(tmp_path_factory):
+    """A briefly-trained LeNet saved to disk."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.models.lenet import LeNet5
+
+    model = LeNet5(10).build(seed=1)
+    path = str(tmp_path_factory.mktemp("models") / "lenet.bin")
+    model.save(path, overwrite=True)
+    return path
+
+
+class TestLoadModelExample:
+    def test_bigdl_model_on_mnist(self, lenet_file, tmp_path, capsys):
+        from bigdl_tpu.example.load_model import main
+
+        _write_mnist_idx(str(tmp_path))
+        main(["--modelType", "bigdl", "--model", lenet_file,
+              "-f", str(tmp_path), "--dataset", "mnist", "-b", "16"])
+        out = capsys.readouterr().out
+        assert "Top1Accuracy" in out and "Top5Accuracy" in out
+
+    def test_torch_model_roundtrip(self, tmp_path, capsys):
+        from bigdl_tpu import nn
+        from bigdl_tpu.example.load_model import main
+
+        model = nn.Sequential(nn.Reshape((784,)), nn.Linear(784, 10),
+                              nn.LogSoftMax()).build(seed=3)
+        t7 = str(tmp_path / "model.t7")
+        model.save_torch(t7, overwrite=True)
+        _write_mnist_idx(str(tmp_path))
+        main(["--modelType", "torch", "--model", t7,
+              "-f", str(tmp_path), "--dataset", "mnist", "-b", "16"])
+        assert "Top1Accuracy" in capsys.readouterr().out
+
+    def test_caffe_requires_factory(self, lenet_file, tmp_path):
+        from bigdl_tpu.example.load_model import main
+
+        with pytest.raises(SystemExit):
+            main(["--modelType", "caffe", "--model", lenet_file,
+                  "-f", str(tmp_path)])
+
+
+class TestImageClassificationExample:
+    @pytest.fixture
+    def image_folder(self, tmp_path):
+        PIL = pytest.importorskip("PIL")
+        from PIL import Image
+
+        rng = np.random.RandomState(1)
+        for cls in ["cat", "dog"]:
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(2):
+                arr = rng.randint(0, 255, size=(40, 40, 3)).astype(np.uint8)
+                Image.fromarray(arr).save(str(d / f"{cls}{i}.png"))
+        return str(tmp_path)
+
+    def test_predict_folder_lenet(self, lenet_file, image_folder, capsys):
+        from bigdl_tpu.example.image_classification import main
+
+        main(["--model", lenet_file, "-f", image_folder,
+              "--modelType", "lenet", "-b", "4", "--topN", "2"])
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 4  # 2 classes x 2 images
+        # each line: "<path>: <c1> <c2>" with 1-based classes
+        for line in out:
+            classes = line.split(": ")[1].split()
+            assert len(classes) == 2
+            assert all(1 <= int(c) <= 10 for c in classes)
+
+    def test_grey_from_bgr(self):
+        from bigdl_tpu.dataset.image import GreyFromBGR
+        from bigdl_tpu.dataset.types import LabeledImage
+
+        img = LabeledImage(np.ones((3, 4, 4), np.float32) * 100, 1.0)
+        grey = GreyFromBGR().transform_one(img)
+        assert grey.data.shape == (1, 4, 4)
+        np.testing.assert_allclose(grey.data, 100.0, rtol=1e-5)

@@ -1,0 +1,128 @@
+"""Pallas kernel tests (interpreter mode on CPU; same code compiles on
+TPU).  Oracle: the plain fused attention in bigdl_tpu.nn.attention."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.nn.attention import dot_product_attention
+from bigdl_tpu.ops import flash_attention
+
+
+def _qkv(b=2, h=2, t=64, d=32, seed=0, dtype=jnp.float32):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, h, t, d).astype(np.float32), dtype)
+    return mk(), mk(), mk()
+
+
+class TestFlashForward:
+    def test_matches_reference(self):
+        q, k, v = _qkv()
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_causal_matches_reference(self):
+        q, k, v = _qkv(seed=1)
+        out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_unaligned_t_padding(self):
+        """T not divisible by the block sizes exercises the pad/mask path."""
+        q, k, v = _qkv(t=50, seed=2)
+        out = flash_attention(q, k, v, block_q=32, block_k=32)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_unaligned_causal(self):
+        q, k, v = _qkv(t=37, seed=3)
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_custom_scale(self):
+        q, k, v = _qkv(seed=4)
+        out = flash_attention(q, k, v, scale=0.5, block_q=32, block_k=32)
+        ref = dot_product_attention(q, k, v, scale=0.5)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_tk_gt_tq(self):
+        """Tk != Tq: key mask must use the KEY length (regression)."""
+        rng = np.random.RandomState(8)
+        q = jnp.asarray(rng.randn(2, 2, 16, 32).astype(np.float32))
+        k = jnp.asarray(rng.randn(2, 2, 64, 32).astype(np.float32))
+        v = jnp.asarray(rng.randn(2, 2, 64, 32).astype(np.float32))
+        out = flash_attention(q, k, v, block_q=16, block_k=16)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_causal_alignment(self):
+        """Causal with Tk > Tq uses bottom-right alignment like the
+        reference attention."""
+        rng = np.random.RandomState(9)
+        q = jnp.asarray(rng.randn(1, 2, 24, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 2, 40, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, 2, 40, 16).astype(np.float32))
+        out = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        ref = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_tk_lt_tq(self):
+        rng = np.random.RandomState(10)
+        q = jnp.asarray(rng.randn(1, 1, 48, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 1, 20, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, 1, 20, 16).astype(np.float32))
+        out = flash_attention(q, k, v, block_q=16, block_k=16)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_single_block(self):
+        q, k, v = _qkv(t=16, seed=5)
+        out = flash_attention(q, k, v, block_q=16, block_k=16)
+        ref = dot_product_attention(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+class TestFlashBackward:
+    def test_grads_match_reference(self):
+        q, k, v = _qkv(t=32, seed=6)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, block_q=16, block_k=16) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+    def test_causal_grads(self):
+        q, k, v = _qkv(t=32, seed=7)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True,
+                                           block_q=16, block_k=16) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+class TestMhaIntegration:
+    def test_mha_flash_path(self):
+        from bigdl_tpu import nn
+
+        mha = nn.MultiHeadAttention(32, 4, causal=True,
+                                    attention_impl="flash").build(seed=1)
+        mha_ref = nn.MultiHeadAttention(32, 4, causal=True).build(seed=1)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 24, 32), jnp.float32)
+        out = mha.f(mha.params, x)
+        ref = mha_ref.f(mha_ref.params, x)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
