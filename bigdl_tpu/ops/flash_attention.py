@@ -170,8 +170,11 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
     if causal:  # bottom-right alignment, same as the forward kernel
         tq, tk = q.shape[2], k.shape[2]
         cmask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
-        s = jnp.where(cmask, s, _NEG)
-    p = jnp.exp(s - lse[..., None])
+        # mask p explicitly: a fully-masked row has lse = _NEG and
+        # exp(_NEG - _NEG) = 1 would resurrect every masked key
+        p = jnp.where(cmask, jnp.exp(s - lse[..., None]), 0.0)
+    else:
+        p = jnp.exp(s - lse[..., None])
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, do32)
     dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v32)
     delta = jnp.sum(do32 * o32, axis=-1)

@@ -18,3 +18,9 @@ from bigdl_tpu.parallel.sequence import (
     ring_attention, ring_attention_local, ulysses_attention,
     ulysses_attention_local, sequence_parallel_self_attention,
 )
+from bigdl_tpu.parallel.tensor_parallel import (
+    column_parallel_spec, row_parallel_spec, shard_params, mha_tp_rules,
+    mlp_tp_rules, constrain_batch,
+)
+from bigdl_tpu.parallel.pipeline import pipeline_apply, pipeline_apply_local
+from bigdl_tpu.parallel.expert import init_moe_params, moe_apply, moe_apply_local

@@ -1,0 +1,98 @@
+"""Expert parallelism: a mixture-of-experts layer sharded over the
+``expert`` mesh axis.
+
+Capability extension beyond the reference (SURVEY.md §5.8; its closest
+ancestor is ``MixtureTable``, which mixes full expert outputs on one
+node).  TPU-first design: dense one-hot dispatch (static shapes — no
+gather/scatter of ragged token sets) with each device computing only its
+local expert slice; a single ``psum`` over the expert axis combines the
+weighted outputs.  Top-1 (switch) routing with a load-balancing auxiliary
+loss.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from bigdl_tpu.parallel.mesh import DATA_AXIS, EXPERT_AXIS
+
+
+def init_moe_params(rng, n_experts: int, d_model: int, d_hidden: int):
+    """Gate + per-expert 2-layer MLPs, stacked on a leading expert dim."""
+    kg, k1, k2 = jax.random.split(rng, 3)
+    scale = 1.0 / jnp.sqrt(d_model)
+    return {
+        "gate": jax.random.uniform(kg, (d_model, n_experts), jnp.float32,
+                                   -scale, scale),
+        "w1": jax.random.uniform(k1, (n_experts, d_model, d_hidden),
+                                 jnp.float32, -scale, scale),
+        "w2": jax.random.uniform(k2, (n_experts, d_hidden, d_model),
+                                 jnp.float32, -scale, scale),
+    }
+
+
+def moe_apply_local(params, x, *, axis: str = EXPERT_AXIS,
+                    data_axis: Optional[str] = None):
+    """Per-device body (inside shard_map over ``axis``).  ``params['w1'/
+    'w2']`` hold the LOCAL expert slice (E_local, ...); ``x`` (T, D) is
+    replicated over the axis.  Returns (y (T, D), aux_loss)."""
+    e_local = params["w1"].shape[0]
+    my_idx = lax.axis_index(axis)
+    n_total = params["gate"].shape[1]
+
+    logits = x @ params["gate"]                         # (T, E) global gate
+    probs = jax.nn.softmax(logits, axis=-1)
+    top = jnp.argmax(probs, axis=-1)                    # (T,) top-1 routing
+    onehot = jax.nn.one_hot(top, n_total, dtype=x.dtype)
+    gate_val = jnp.sum(probs * onehot, axis=-1)         # (T,)
+
+    # dense dispatch to the local slice only
+    lo = my_idx * e_local
+    local_mask = lax.dynamic_slice_in_dim(onehot, lo, e_local, axis=1)
+    dispatched = jnp.einsum("te,td->etd", local_mask, x)     # (E_l, T, D)
+    h = jax.nn.relu(jnp.einsum("etd,edh->eth", dispatched, params["w1"]))
+    out = jnp.einsum("eth,ehd->etd", h, params["w2"])        # (E_l, T, D)
+    y_local = jnp.einsum("etd,te->td", out, local_mask)
+    y = lax.psum(y_local, axis) * gate_val[:, None]
+
+    # switch-transformer load-balancing loss: n_total * sum_e f_e * p_e
+    frac = jnp.mean(onehot, axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    if data_axis is not None:
+        # global Switch loss: average f_e and P_e over token shards FIRST
+        # (averaging the per-shard products would add a cross-shard
+        # covariance term and penalize shard-skewed-but-balanced routing)
+        frac = lax.pmean(frac, data_axis)
+        mean_p = lax.pmean(mean_p, data_axis)
+    aux = n_total * jnp.sum(frac * mean_p)
+    return y, aux
+
+
+def moe_apply(params, x, mesh: Mesh, *, axis: str = EXPERT_AXIS,
+              data_axis: Optional[str] = None):
+    """Global-view MoE over tokens ``x`` (T, D) (or (B, T, D) — flattened
+    internally).  Experts shard over ``axis``; pass ``data_axis`` to keep
+    the token batch sharded over it on a 2-D mesh.  Returns (y, aux)."""
+    shape = x.shape
+    if x.ndim == 3:
+        x = x.reshape(-1, shape[-1])
+    xspec = P(data_axis, None) if data_axis else P(None, None)
+    pspec = {"gate": P(None, None), "w1": P(axis, None, None),
+             "w2": P(axis, None, None)}
+    fn = shard_map(partial(moe_apply_local, axis=axis, data_axis=data_axis),
+                   mesh=mesh, in_specs=(pspec, xspec),
+                   out_specs=(xspec, P()))
+    y, aux = fn(params, x)
+    if len(shape) == 3:
+        y = y.reshape(shape)
+    return y, jnp.mean(aux)

@@ -1,0 +1,108 @@
+"""Pipeline parallelism: GPipe-style microbatch rotation over the
+``pipeline`` mesh axis.
+
+Capability extension beyond the reference (SURVEY.md §5.8).  TPU-first
+design: the schedule is a statically-bounded loop inside ``shard_map`` —
+each device owns ONE stage's parameters, activations hop to the next
+stage with ``lax.ppermute`` (a neighbor exchange riding ICI), and the
+loop runs ``n_micro + n_stages - 1`` ticks so every stage is busy once
+the pipeline fills.  Reverse-mode AD differentiates straight through the
+loop and the ppermutes (the transpose of a ppermute is the reverse
+ppermute), so one ``jax.grad`` over ``pipeline_apply`` is pipeline-
+parallel backprop.
+
+Constraint: every stage must map activations to the same shape/dtype
+(true for residual-style towers), because the rotating buffer is a single
+static-shape array.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+from bigdl_tpu.parallel.mesh import PIPELINE_AXIS
+
+
+def pipeline_apply_local(stage_fn: Callable, stage_params, x_micro, *,
+                         axis: str = PIPELINE_AXIS):
+    """Per-device body (run inside shard_map over ``axis``).
+
+    stage_params: THIS stage's params (leading pipeline dim stripped).
+    x_micro: (M, mb, ...) microbatched input, replicated over the axis.
+    Returns (M, mb, ...) outputs, replicated (psum-broadcast from the
+    last stage)."""
+    stage = lax.axis_index(axis)
+    n = lax.psum(1, axis)  # static: mesh axis size
+    m = x_micro.shape[0]
+    total = m + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    y_shape = jax.eval_shape(stage_fn, stage_params, x_micro[0])
+    assert y_shape.shape == x_micro.shape[1:], (
+        "pipeline stages must preserve activation shape "
+        f"(got {y_shape.shape} vs {x_micro.shape[1:]})")
+
+    def tick(t, state):
+        buf, outs = state
+        mb_idx = jnp.clip(t, 0, m - 1)
+        # stage 0 injects a fresh microbatch; others consume the rotated buf
+        inp = jnp.where(stage == 0, x_micro[mb_idx], buf)
+        y = stage_fn(stage_params, inp)
+        out_idx = t - (n - 1)  # microbatch leaving the last stage this tick
+        write = jnp.logical_and(stage == n - 1, out_idx >= 0)
+        updated = lax.dynamic_update_index_in_dim(
+            outs, y, jnp.clip(out_idx, 0, m - 1), 0)
+        outs = jnp.where(write, updated, outs)
+        buf = lax.ppermute(y, axis, perm)
+        return buf, outs
+
+    # inits must be marked varying over the shard_map axis (plain zeros
+    # would be replicated and fail the loop-carry type check); adding a
+    # zeroed axis_index does that without an extra stage_fn evaluation
+    vary0 = (lax.axis_index(axis) * 0).astype(y_shape.dtype)
+    buf0 = jnp.zeros(y_shape.shape, y_shape.dtype) + vary0
+    outs0 = jnp.zeros((m,) + y_shape.shape, y_shape.dtype) + vary0
+    _, outs = lax.fori_loop(0, total, tick, (buf0, outs0), unroll=True)
+    # only the last stage holds real outputs; psum broadcasts them (all
+    # other stages contribute zeros)
+    return lax.psum(jnp.where(stage == n - 1, outs, 0.0), axis)
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x, mesh: Mesh, *,
+                   n_microbatches: int, axis: str = PIPELINE_AXIS):
+    """Global-view GPipe: ``stacked_params`` has a leading stage dim of
+    size mesh.shape[axis] (stage i's params at index i); ``x`` is
+    (batch, ...).  The batch is split into ``n_microbatches`` and pushed
+    through the stages; returns (batch, ...) outputs.
+
+    stage_fn(params_i, x_mb) -> y_mb must preserve shape."""
+    b = x.shape[0]
+    assert b % n_microbatches == 0, "batch must divide into microbatches"
+    x_micro = x.reshape((n_microbatches, b // n_microbatches) + x.shape[1:])
+
+    p_spec = jax.tree_util.tree_map(
+        lambda leaf: P(axis, *([None] * (leaf.ndim - 1))), stacked_params)
+    fn = shard_map(
+        partial(_pipeline_body, stage_fn, axis),
+        mesh=mesh,
+        in_specs=(p_spec, P()),
+        out_specs=P(),
+    )
+    y_micro = fn(stacked_params, x_micro)
+    return y_micro.reshape((b,) + y_micro.shape[2:])
+
+
+def _pipeline_body(stage_fn, axis, stacked_params, x_micro):
+    # strip the leading (size-1 after sharding) stage dim from each leaf
+    local = jax.tree_util.tree_map(lambda a: a[0], stacked_params)
+    return pipeline_apply_local(stage_fn, local, x_micro, axis=axis)

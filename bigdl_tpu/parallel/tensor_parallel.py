@@ -1,0 +1,93 @@
+"""Tensor (model) parallelism: width-sharded layers over the ``model``
+mesh axis.
+
+Capability extension beyond the reference (SURVEY.md §5.8: DP is its only
+strategy), done the pjit way: parameters carry ``NamedSharding``s and
+activations get ``with_sharding_constraint`` hints; XLA inserts the
+all-gather/reduce-scatter collectives over ICI.  The Megatron pairing —
+column-parallel (output-dim shard, no comm forward) into row-parallel
+(input-dim shard, one psum) — means one collective per MLP/attention
+block rather than per layer.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from bigdl_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def column_parallel_spec(mesh: Mesh, axis: str = MODEL_AXIS) -> NamedSharding:
+    """(in, out) weight with the OUTPUT dim sharded: y = x @ W yields
+    activations sharded on their last dim; no forward communication."""
+    return NamedSharding(mesh, P(None, axis))
+
+
+def row_parallel_spec(mesh: Mesh, axis: str = MODEL_AXIS) -> NamedSharding:
+    """(in, out) weight with the INPUT dim sharded: consumes
+    column-parallel activations; XLA inserts one psum on the output."""
+    return NamedSharding(mesh, P(axis, None))
+
+
+def replicated_spec(mesh: Mesh) -> NamedSharding:
+    from bigdl_tpu.parallel.mesh import replicated
+    return replicated(mesh)
+
+
+def shard_params(params: Any, rules: Callable[[tuple, Any], Optional[NamedSharding]],
+                 mesh: Mesh) -> Any:
+    """Device-put each param leaf according to ``rules(path, leaf)``;
+    leaves with no rule are replicated.  ``path`` is the jax key-path
+    tuple (use jax.tree_util.keystr to match by name)."""
+    rep = replicated_spec(mesh)
+
+    def place(path, leaf):
+        return jax.device_put(leaf, rules(path, leaf) or rep)
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def mha_tp_rules(mesh: Mesh, axis: str = MODEL_AXIS):
+    """Sharding rules for ``MultiHeadAttention`` params: q/k/v projections
+    column-parallel (heads shard over ``axis``), output projection
+    row-parallel — the Megatron attention pattern (one psum per block)."""
+    col, row, rep = (column_parallel_spec(mesh, axis),
+                     row_parallel_spec(mesh, axis), replicated_spec(mesh))
+
+    def rules(path, leaf):
+        name = jax.tree_util.keystr(path)
+        if any(w in name for w in ("wq", "wk", "wv")):
+            return col
+        if "wo" in name:
+            return row
+        if any(b in name for b in ("bq", "bk", "bv")):
+            return NamedSharding(mesh, P(axis))  # bias follows the shard
+        return rep
+
+    return rules
+
+
+def mlp_tp_rules(mesh: Mesh, first_weight: str, second_weight: str,
+                 axis: str = MODEL_AXIS):
+    """Column-parallel first linear, row-parallel second: matches any
+    two-layer MLP given the param-path substrings of its weights."""
+    col, row, rep = (column_parallel_spec(mesh, axis),
+                     row_parallel_spec(mesh, axis), replicated_spec(mesh))
+
+    def rules(path, leaf):
+        name = jax.tree_util.keystr(path)
+        if first_weight in name:
+            return col if leaf.ndim == 2 else NamedSharding(mesh, P(axis))
+        if second_weight in name:
+            return row if leaf.ndim == 2 else rep
+        return rep
+
+    return rules
+
+
+def constrain_batch(x, mesh: Mesh, axis: str = DATA_AXIS):
+    """Pin the batch dim sharding inside a jitted step (activations)."""
+    from bigdl_tpu.parallel.mesh import batch_sharding
+    return jax.lax.with_sharding_constraint(x, batch_sharding(mesh, x.ndim, axis))

@@ -115,6 +115,30 @@ class TestFlashBackward:
             np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
 
+class TestFlashBackwardCross:
+    def test_causal_tq_gt_tk_grads(self):
+        """Regression: rows with NO visible keys (causal, Tq > Tk) must
+        get zero attention in the backward too; a loss with non-zero
+        cotangent on those rows exposed p=exp(_NEG - _NEG)=1."""
+        rng = np.random.RandomState(11)
+        q = jnp.asarray(rng.randn(1, 1, 48, 16).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 1, 20, 16).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, 1, 20, 16).astype(np.float32))
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+            return jnp.sum((o - 1.0) ** 2)  # do != 0 on masked rows
+
+        def loss_ref(q, k, v):
+            o = dot_product_attention(q, k, v, causal=True)
+            return jnp.sum((o - 1.0) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
 class TestMhaIntegration:
     def test_mha_flash_path(self):
         from bigdl_tpu import nn
