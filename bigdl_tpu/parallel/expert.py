@@ -24,7 +24,7 @@ try:
 except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map
 
-from bigdl_tpu.parallel.mesh import DATA_AXIS, EXPERT_AXIS
+from bigdl_tpu.parallel.mesh import EXPERT_AXIS
 
 
 def init_moe_params(rng, n_experts: int, d_model: int, d_hidden: int):

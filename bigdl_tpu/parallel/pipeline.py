@@ -72,7 +72,8 @@ def pipeline_apply_local(stage_fn: Callable, stage_params, x_micro, *,
     vary0 = (lax.axis_index(axis) * 0).astype(y_shape.dtype)
     buf0 = jnp.zeros(y_shape.shape, y_shape.dtype) + vary0
     outs0 = jnp.zeros((m,) + y_shape.shape, y_shape.dtype) + vary0
-    _, outs = lax.fori_loop(0, total, tick, (buf0, outs0), unroll=True)
+    # static bounds -> scan lowering: rolled body, differentiable
+    _, outs = lax.fori_loop(0, total, tick, (buf0, outs0))
     # only the last stage holds real outputs; psum broadcasts them (all
     # other stages contribute zeros)
     return lax.psum(jnp.where(stage == n - 1, outs, 0.0), axis)
