@@ -30,9 +30,17 @@ class Container(Module):
     def __init__(self, *modules: Module):
         super().__init__()
         self.modules: list[Module] = list(modules)
+        self.remat: bool = False
 
     def add(self, module: Module) -> "Container":
         self.modules.append(module)
+        return self
+
+    def checkpoint(self, enable: bool = True) -> "Container":
+        """Rematerialize each child's activations in the backward pass
+        (``jax.checkpoint`` per child): trades recompute FLOPs for HBM,
+        the standard TPU memory knob for deep towers."""
+        self.remat = enable
         return self
 
     def __len__(self) -> int:
@@ -49,11 +57,20 @@ class Container(Module):
         return {str(i): m.init_buffers() for i, m in enumerate(self.modules)}
 
     def _child_apply(self, i, params, x, buffers, training, rng):
-        y, b = self.modules[i].apply(
-            params.get(str(i), {}) if params else {}, x,
-            buffers=buffers.get(str(i), {}) if buffers else {},
-            training=training, rng=fold_rng(rng, i))
-        return y, b
+        p = params.get(str(i), {}) if params else {}
+        b_in = buffers.get(str(i), {}) if buffers else {}
+        r = fold_rng(rng, i)
+        if getattr(self, "remat", False):
+            # rematerialize child activations in the backward pass
+            # (jax.checkpoint: trades FLOPs for HBM — the TPU-idiomatic
+            # memory knob; the reference has no analog, its activations
+            # live in JVM heap caches)
+            def run(p, x, b_in):
+                return self.modules[i].apply(p, x, buffers=b_in,
+                                             training=training, rng=r)
+            return jax.checkpoint(run)(p, x, b_in)
+        return self.modules[i].apply(p, x, buffers=b_in,
+                                     training=training, rng=r)
 
     # OO-shell aggregation (ref Container aggregates over children)
     def training(self) -> "Container":

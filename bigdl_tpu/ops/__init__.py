@@ -6,4 +6,6 @@ kernels where explicit control over VMEM/MXU tiling beats XLA's default
 schedule.  Every op has a pure-XLA fallback; kernels run in interpreter
 mode off-TPU so the test suite exercises them on CPU.
 """
-from bigdl_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from bigdl_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention, flash_attention_with_lse,
+)

@@ -89,6 +89,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             jnp.float32)
 
 
+def _sds(shape, dtype, *like):
+    """ShapeDtypeStruct carrying the union of the inputs' varying-over-
+    mesh-axes sets (the output varies over any axis ANY input varies
+    over — e.g. replicated q with sequence-sharded k/v), so the kernel
+    works inside shard_map (check_vma) and outside it."""
+    vma = frozenset()
+    for x in like:
+        vma = vma | (getattr(jax.typeof(x), "vma", None) or frozenset())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _pad_t(x, block):
     t = x.shape[2]
     rem = t % block
@@ -129,8 +142,8 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
                          lambda bi, hi, qi, ki: (bi, hi, qi)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, tq_pad, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, tq_pad), jnp.float32),
+            _sds((b, h, tq_pad, d), q.dtype, q, k, v),
+            _sds((b, h, tq_pad), jnp.float32, q, k, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),       # acc
@@ -159,11 +172,11 @@ def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
     return o, (q, k, v, o, lse)
 
 
-def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
+def _flash_bwd_core(causal, scale, res, do, dlse=None):
+    """Recomputation backward shared by both VJPs.  With ``dlse`` (the
+    cotangent of the logsumexp output): d lse_i / d s_ij = p_ij, so it
+    adds ``p * dlse`` to the score cotangent."""
     q, k, v, o, lse = res
-    # standard flash backward: recompute P from q,k and the saved
-    # logsumexp, then one fused XLA expression (per-block pallas backward
-    # is a later optimization; XLA already tiles these matmuls)
     q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
     do32, o32 = do.astype(jnp.float32), o.astype(jnp.float32)
     s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32) * scale
@@ -179,12 +192,38 @@ def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
     dp = jnp.einsum("bhqd,bhkd->bhqk", do32, v32)
     delta = jnp.sum(do32 * o32, axis=-1)
     ds = p * (dp - delta[..., None])
+    if dlse is not None:
+        ds = ds + p * dlse.astype(jnp.float32)[..., None]
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k32) * scale
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q32) * scale
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
+def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
+    return _flash_bwd_core(causal, scale, res, do)
+
+
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_lse(q, k, v, causal, scale, block_q, block_k):
+    return _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                      _use_interpret())
+
+
+def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
+                        _use_interpret())
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, res, cts):
+    do, dlse = cts
+    return _flash_bwd_core(causal, scale, res, do, dlse)
+
+
+_flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
@@ -198,3 +237,24 @@ def flash_attention(q, k, v, *, causal: bool = False,
         scale = 1.0 / math.sqrt(q.shape[-1])
     return _flash(q, k, v, causal, float(scale),
                   int(block_q), int(block_k))
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 128, block_k: int = 128):
+    """Flash attention that also returns the logsumexp (B, H, Tq) of the
+    scaled scores.  Two partial results over disjoint key sets merge
+    exactly via logsumexp weighting::
+
+        lse = logaddexp(lse_a, lse_b)
+        o   = o_a * exp(lse_a - lse) + o_b * exp(lse_b - lse)
+
+    which is how ``bigdl_tpu.parallel.sequence`` composes this kernel
+    into ring attention (each ring hop contributes one (o, lse) pair).
+    Fully-masked rows report lse ~ -1e30 and o = 0, the identity of that
+    merge.  Differentiable: the lse cotangent folds into the score
+    cotangent as ``p * dlse`` (d lse/d s = softmax)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_lse(q, k, v, causal, float(scale), int(block_q),
+                      int(block_k))

@@ -31,22 +31,33 @@ from bigdl_tpu.parallel.mesh import SEQUENCE_AXIS
 
 
 def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None,
+                         impl: str = "blocks", block_size: int = 128):
     """Per-shard body of ring attention.  Must run inside ``shard_map``
     (or pmap) with ``axis_name`` bound; q, k, v: (B, H, T_local, D) — the
     local sequence shard.  Returns the local (B, H, T_local, D) output.
 
     Round r computes q against the k/v block that started on device
     (my_index - r) mod N, then passes its current block to the next device
-    (a pure neighbor ppermute: ICI-friendly, no all-gather)."""
+    (a pure neighbor ppermute: ICI-friendly, no all-gather).
+
+    ``impl="flash"`` computes each hop's partial attention with the
+    Pallas flash kernel (bigdl_tpu.ops.flash_attention_with_lse) and
+    merges hops by logsumexp weighting — the long-context hot path:
+    VMEM-tiled inner attention composed with ICI ring exchanges."""
+    if impl == "flash":
+        return _ring_attention_local_flash(q, k, v, axis_name, causal=causal,
+                                           scale=scale, block_size=block_size)
+    if impl != "blocks":
+        raise ValueError(f"impl must be 'blocks' or 'flash', got {impl!r}")
     n = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     t_local = q.shape[-2]
     q_pos = my_idx * t_local + jnp.arange(t_local)  # global positions
-    perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def compute(r, o, l, m, kr, vr):
+    def hop(r, state, kr, vr):
+        o, l, m = state
         src = (my_idx - r) % n  # which shard this k/v block came from
         if not causal:
             return online_softmax_update(
@@ -62,34 +73,103 @@ def ring_attention_local(q, k, v, axis_name: str, *, causal: bool = False,
 
         return lax.cond(src > my_idx, lambda _: (o, l, m), masked_block, None)
 
-    def step(r, carry):  # rounds 0..n-2: compute, then rotate k/v onward
-        o, l, m, kr, vr = carry
-        o, l, m = compute(r, o, l, m, kr, vr)
-        kr = lax.ppermute(kr, axis_name, perm)
-        vr = lax.ppermute(vr, axis_name, perm)
-        return o, l, m, kr, vr
-
-    # derive init from q so the carry is marked varying over the shard_map
-    # axis (a plain jnp.zeros would be replicated and fail the vma check)
+    # derive inits from q so the carry is marked varying over the
+    # shard_map axis (plain jnp.zeros would be replicated, failing vma)
     o0 = q * 0.0
     l0 = q[..., 0] * 0.0
     m0 = q[..., 0] * 0.0 + NEG_INF
-    o, l, m, kr, vr = lax.fori_loop(0, n - 1, step, (o0, l0, m0, k, v))
-    # final round: compute only — rotating k/v once more would be pure
-    # wasted ICI traffic (the carry is discarded)
-    o, l, _ = compute(n - 1, o, l, m, kr, vr)
+    o, l, _ = _ring_schedule(axis_name, n, k, v, (o0, l0, m0), hop)
     return _finalize(o, l)
 
 
+def _ring_schedule(axis_name: str, n, k, v, state0, hop):
+    """The ring loop shared by both impls: rounds 0..n-1 of
+    ``state = hop(r, state, kr, vr)``, rotating k/v to the next device
+    after every round but the last (that rotation's carry would be
+    discarded — pure wasted ICI traffic)."""
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(r, carry):
+        state, kr, vr = carry
+        state = hop(r, state, kr, vr)
+        return (state, lax.ppermute(kr, axis_name, perm),
+                lax.ppermute(vr, axis_name, perm))
+
+    state, kr, vr = lax.fori_loop(0, n - 1, step, (state0, k, v))
+    return hop(n - 1, state, kr, vr)
+
+
+def _ring_attention_local_flash(q, k, v, axis_name: str, *,
+                                causal: bool = False,
+                                scale: Optional[float] = None,
+                                block_size: int = 128):
+    """Ring attention with the Pallas flash kernel as the per-hop compute.
+
+    Each hop yields a normalized partial (o_blk, lse_blk) over its key
+    shard; disjoint-key partials merge exactly by logsumexp weighting.
+    Causality by shard position: past shards attend unmasked, the
+    diagonal shard uses the kernel's causal mask (Tq == Tk, aligned),
+    future shards are skipped entirely (lax.cond saves their FLOPs).
+    Accumulation runs in float32 regardless of input dtype (bf16 inputs
+    feed the kernel's MXU tiles; the output is cast back)."""
+    from bigdl_tpu.ops import flash_attention_with_lse
+
+    n = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    bq = min(block_size, q.shape[-2])
+    bk = min(block_size, k.shape[-2])
+
+    def hop(r, state, kr, vr):
+        o, lse = state
+        src = (my_idx - r) % n  # which shard this k/v block came from
+
+        def run(is_causal):
+            def f(_):
+                ob, lb = flash_attention_with_lse(
+                    q, kr, vr, causal=is_causal, scale=scale,
+                    block_q=bq, block_k=bk)
+                return ob.astype(jnp.float32), lb
+            return f
+
+        def skip(_):  # merge identity: o = 0, lse = -inf-ish
+            # derive from q so the outputs carry q's varying-over-axis
+            # marking and match the flash branches' types
+            zero = (q[..., 0] * 0.0).astype(jnp.float32)
+            return (q * 0.0).astype(jnp.float32), zero + NEG_INF
+
+        if causal:
+            o_blk, lse_blk = lax.cond(
+                src > my_idx, skip,
+                lambda _: lax.cond(src == my_idx, run(True), run(False),
+                                   None), None)
+        else:
+            o_blk, lse_blk = run(False)(None)
+        # exact merge of normalized partials over disjoint key sets
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        w_old = jnp.exp(lse - lse_new)
+        w_blk = jnp.exp(lse_blk - lse_new)
+        o = o * w_old[..., None] + o_blk * w_blk[..., None]
+        return o, lse_new
+
+    o0 = (q * 0.0).astype(jnp.float32)
+    lse0 = (q[..., 0] * 0.0).astype(jnp.float32) + NEG_INF
+    o, _ = _ring_schedule(axis_name, n, k, v, (o0, lse0), hop)
+    return o.astype(q.dtype)
+
+
 def ring_attention(q, k, v, mesh: Mesh, *, axis: str = SEQUENCE_AXIS,
-                   batch_axis: Optional[str] = None, causal: bool = False):
+                   batch_axis: Optional[str] = None, causal: bool = False,
+                   impl: str = "blocks", block_size: int = 128):
     """Global-view ring attention: q, k, v are (B, H, T, D) arrays (sharded
     or not); T is sharded over ``axis`` and the ring runs over that mesh
     axis.  On a 2-D mesh pass ``batch_axis`` so the batch dim stays
-    data-sharded instead of being gathered."""
+    data-sharded instead of being gathered.  ``impl="flash"`` uses the
+    Pallas flash kernel for each hop's partial attention."""
     spec = P(batch_axis, None, axis, None)
     fn = shard_map(
-        partial(ring_attention_local, axis_name=axis, causal=causal),
+        partial(ring_attention_local, axis_name=axis, causal=causal,
+                impl=impl, block_size=block_size),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 

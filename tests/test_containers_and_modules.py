@@ -297,3 +297,27 @@ class TestNms:
         scores = np.array([0.9, 0.8, 0.7], dtype=np.float32)
         keep = nn.Nms(0.5, 10)(boxes, scores)
         assert keep.tolist() == [1, 3]  # 1-based
+
+
+class TestCheckpointRemat:
+    def test_grads_identical_with_remat(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+
+        def build(remat):
+            m = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 8))
+            if remat:
+                m.checkpoint()
+            return m.build(seed=1)
+
+        def grads(m):
+            def loss(p):
+                return jnp.sum(m.apply(p, jnp.asarray(x), training=True)[0] ** 2)
+            return jax.grad(loss)(m.params)
+
+        g1, g2 = grads(build(True)), grads(build(False))
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)

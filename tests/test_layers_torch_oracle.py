@@ -237,3 +237,39 @@ class TestEmbeddingEtc:
         y, _ = m.apply({}, [jnp.asarray(x1), jnp.asarray(x2)])
         ref = F.cosine_similarity(torch.from_numpy(x1), torch.from_numpy(x2), dim=-1)
         np.testing.assert_allclose(np.asarray(y), t2n(ref), rtol=1e-3, atol=1e-4)
+
+
+class TestAttentionOracle:
+    """Flash/plain attention vs torch.scaled_dot_product_attention."""
+
+    def _qkv(self, nprng, t=24, d=16):
+        mk = lambda: nprng.randn(2, 2, t, d).astype(np.float32)
+        return mk(), mk(), mk()
+
+    def test_plain_matches_torch(self, nprng):
+        from bigdl_tpu.nn.attention import dot_product_attention
+        q, k, v = self._qkv(nprng)
+        out = dot_product_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+        ref = F.scaled_dot_product_attention(
+            torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v))
+        np.testing.assert_allclose(np.asarray(out), t2n(ref), **TOL)
+
+    def test_causal_matches_torch(self, nprng):
+        from bigdl_tpu.nn.attention import dot_product_attention
+        q, k, v = self._qkv(nprng)
+        out = dot_product_attention(jnp.asarray(q), jnp.asarray(k),
+                                    jnp.asarray(v), causal=True)
+        ref = F.scaled_dot_product_attention(
+            torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
+            is_causal=True)
+        np.testing.assert_allclose(np.asarray(out), t2n(ref), **TOL)
+
+    def test_flash_matches_torch(self, nprng):
+        from bigdl_tpu.ops import flash_attention
+        q, k, v = self._qkv(nprng, t=32)
+        out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                              causal=True, block_q=16, block_k=16)
+        ref = F.scaled_dot_product_attention(
+            torch.from_numpy(q), torch.from_numpy(k), torch.from_numpy(v),
+            is_causal=True)
+        np.testing.assert_allclose(np.asarray(out), t2n(ref), **TOL)
