@@ -8,13 +8,16 @@ repo publishes no numbers of its own, so the target is the driver's.
 Recipe: bf16 compute (activations + conv/matmul weights feed the MXU in
 bf16), f32 master weights and optimizer state (the TPU rendering of the
 reference's 'fp16 for transport, f32 for state' split,
-parameters/AllReduceParameter.scala).  Timing syncs via a host transfer of
+parameters/AllReduceParameter.scala); NHWC activations throughout (the
+MXU-native layout — the NCHW Torch-parity layout makes XLA insert
+relayout ops around every conv).  Timing syncs via a host transfer of
 the loss each window — on this backend ``block_until_ready`` alone does
 not guarantee completion.
 """
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -28,8 +31,9 @@ def main() -> None:
     from bigdl_tpu.optim import SGD
 
     n_chips = jax.device_count()
-    batch = 128
-    model = ResNet(class_num=1000, depth=50, dataset="imagenet").build(seed=1)
+    batch = int(os.environ.get("BIGDL_TPU_BENCH_BATCH", "256"))
+    model = ResNet(class_num=1000, depth=50, dataset="imagenet",
+                   data_format="NHWC").build(seed=1)
     criterion = nn.ClassNLLCriterion()
     method = SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
 
@@ -58,7 +62,7 @@ def main() -> None:
         new_params, new_opt = method.update(grads, opt_state, params)
         return new_params, nb, new_opt, loss
 
-    x = jnp.asarray(np.random.RandomState(0).randn(batch, 3, 224, 224),
+    x = jnp.asarray(np.random.RandomState(0).randn(batch, 224, 224, 3),
                     jnp.bfloat16)
     y = jnp.asarray(np.random.RandomState(1).randint(1, 1001, size=batch)
                     .astype(np.float32))

@@ -4,66 +4,86 @@ dimension change), C (projection everywhere), for CIFAR-10 and ImageNet.
 
 DAG structure is expressed as ConcatTable + CAddTable exactly like the
 reference (there is no Graph module in v0.1; ResNet.scala:142-205).
+
+``data_format="NHWC"`` builds the TPU-fast variant: every
+conv/pool/batchnorm runs in channels-last layout (the layout the MXU
+wants, avoiding the per-conv relayout ops XLA inserts for NCHW) and the
+model takes NHWC input — which is also the natural image-decode layout,
+so the data pipeline skips its HWC->CHW transpose entirely.  Weight
+storage is OIHW in both modes and the param pytree structure is
+identical, so checkpoints and .t7/Caffe imports are interchangeable
+across formats.  Feeding NCHW data to an NHWC model requires one
+``nn.Transpose([(2, 3), (3, 4)])`` in front.
 """
 from __future__ import annotations
 
 from bigdl_tpu import nn
 
 
-def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str) -> nn.Module:
+def _shortcut(n_in: int, n_out: int, stride: int, shortcut_type: str,
+              df: str) -> nn.Module:
     use_conv = shortcut_type == "C" or (shortcut_type == "B" and n_in != n_out)
+    channel_dim = 2 if df == "NCHW" else 4  # 1-based concat dim
     if use_conv:
         return nn.Sequential(
-            nn.SpatialConvolution(n_in, n_out, 1, 1, stride, stride),
-            nn.SpatialBatchNormalization(n_out),
+            nn.SpatialConvolution(n_in, n_out, 1, 1, stride, stride,
+                                  data_format=df),
+            nn.SpatialBatchNormalization(n_out, data_format=df),
         )
     if n_in != n_out:  # type A: strided identity + zero-pad channels
         return nn.Sequential(
-            nn.SpatialAveragePooling(1, 1, stride, stride),
-            nn.Concat(2, nn.Identity(), nn.MulConstant(0.0)),
+            nn.SpatialAveragePooling(1, 1, stride, stride, data_format=df),
+            nn.Concat(channel_dim, nn.Identity(), nn.MulConstant(0.0)),
         )
     return nn.Identity()
 
 
-def _basic_block(n_in: int, n_out: int, stride: int, shortcut_type: str) -> nn.Module:
+def _basic_block(n_in: int, n_out: int, stride: int, shortcut_type: str,
+                 df: str) -> nn.Module:
     main = nn.Sequential(
-        nn.SpatialConvolution(n_in, n_out, 3, 3, stride, stride, 1, 1),
-        nn.SpatialBatchNormalization(n_out),
+        nn.SpatialConvolution(n_in, n_out, 3, 3, stride, stride, 1, 1,
+                              data_format=df),
+        nn.SpatialBatchNormalization(n_out, data_format=df),
         nn.ReLU(True),
-        nn.SpatialConvolution(n_out, n_out, 3, 3, 1, 1, 1, 1),
-        nn.SpatialBatchNormalization(n_out),
+        nn.SpatialConvolution(n_out, n_out, 3, 3, 1, 1, 1, 1, data_format=df),
+        nn.SpatialBatchNormalization(n_out, data_format=df),
     )
     return nn.Sequential(
-        nn.ConcatTable(main, _shortcut(n_in, n_out, stride, shortcut_type)),
+        nn.ConcatTable(main, _shortcut(n_in, n_out, stride, shortcut_type, df)),
         nn.CAddTable(True),
         nn.ReLU(True),
     )
 
 
-def _bottleneck(n_in: int, n_mid: int, stride: int, shortcut_type: str) -> nn.Module:
+def _bottleneck(n_in: int, n_mid: int, stride: int, shortcut_type: str,
+                df: str) -> nn.Module:
     n_out = n_mid * 4
     main = nn.Sequential(
-        nn.SpatialConvolution(n_in, n_mid, 1, 1, 1, 1),
-        nn.SpatialBatchNormalization(n_mid),
+        nn.SpatialConvolution(n_in, n_mid, 1, 1, 1, 1, data_format=df),
+        nn.SpatialBatchNormalization(n_mid, data_format=df),
         nn.ReLU(True),
-        nn.SpatialConvolution(n_mid, n_mid, 3, 3, stride, stride, 1, 1),
-        nn.SpatialBatchNormalization(n_mid),
+        nn.SpatialConvolution(n_mid, n_mid, 3, 3, stride, stride, 1, 1,
+                              data_format=df),
+        nn.SpatialBatchNormalization(n_mid, data_format=df),
         nn.ReLU(True),
-        nn.SpatialConvolution(n_mid, n_out, 1, 1, 1, 1),
-        nn.SpatialBatchNormalization(n_out),
+        nn.SpatialConvolution(n_mid, n_out, 1, 1, 1, 1, data_format=df),
+        nn.SpatialBatchNormalization(n_out, data_format=df),
     )
     return nn.Sequential(
-        nn.ConcatTable(main, _shortcut(n_in, n_out, stride, shortcut_type)),
+        nn.ConcatTable(main, _shortcut(n_in, n_out, stride, shortcut_type, df)),
         nn.CAddTable(True),
         nn.ReLU(True),
     )
 
 
 def ResNet(class_num: int = 1000, depth: int = 50, shortcut_type: str = "B",
-           dataset: str = "imagenet") -> nn.Sequential:
+           dataset: str = "imagenet", data_format: str = "NCHW") -> nn.Sequential:
     """ResNet factory (ref ResNet.scala apply): ``dataset`` is 'imagenet'
     (7x7 stem, bottleneck for depth>=50) or 'cifar10' (3x3 stem,
     basic blocks, depth = 6n+2)."""
+    df = data_format
+    if df not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {df!r}")
     model = nn.Sequential()
     if dataset == "imagenet":
         cfgs = {18: ([2, 2, 2, 2], 512, _basic_block),
@@ -74,18 +94,18 @@ def ResNet(class_num: int = 1000, depth: int = 50, shortcut_type: str = "B",
         if depth not in cfgs:
             raise ValueError(f"unsupported imagenet depth {depth}")
         blocks, n_features, block = cfgs[depth]
-        model.add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3))
-        model.add(nn.SpatialBatchNormalization(64))
+        model.add(nn.SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, data_format=df))
+        model.add(nn.SpatialBatchNormalization(64, data_format=df))
         model.add(nn.ReLU(True))
-        model.add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+        model.add(nn.SpatialMaxPooling(3, 3, 2, 2, 1, 1, data_format=df))
         widths = [64, 128, 256, 512]
         n_in = 64
         for i, (n_blocks, width) in enumerate(zip(blocks, widths)):
             for j in range(n_blocks):
                 stride = 2 if (i > 0 and j == 0) else 1
-                model.add(block(n_in, width, stride, shortcut_type))
+                model.add(block(n_in, width, stride, shortcut_type, df))
                 n_in = width * 4 if block is _bottleneck else width
-        model.add(nn.SpatialAveragePooling(7, 7, 1, 1))
+        model.add(nn.SpatialAveragePooling(7, 7, 1, 1, data_format=df))
         model.add(nn.View(n_features))
         model.add(nn.Linear(n_features, class_num))
         model.add(nn.LogSoftMax())
@@ -93,16 +113,16 @@ def ResNet(class_num: int = 1000, depth: int = 50, shortcut_type: str = "B",
         if (depth - 2) % 6 != 0:
             raise ValueError("cifar10 resnet depth must be 6n+2")
         n = (depth - 2) // 6
-        model.add(nn.SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1))
-        model.add(nn.SpatialBatchNormalization(16))
+        model.add(nn.SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1, data_format=df))
+        model.add(nn.SpatialBatchNormalization(16, data_format=df))
         model.add(nn.ReLU(True))
         n_in = 16
         for width, first_stride in ((16, 1), (32, 2), (64, 2)):
             for j in range(n):
                 model.add(_basic_block(n_in, width, first_stride if j == 0 else 1,
-                                       shortcut_type))
+                                       shortcut_type, df))
                 n_in = width
-        model.add(nn.SpatialAveragePooling(8, 8, 1, 1))
+        model.add(nn.SpatialAveragePooling(8, 8, 1, 1, data_format=df))
         model.add(nn.View(64))
         model.add(nn.Linear(64, class_num))
         model.add(nn.LogSoftMax())

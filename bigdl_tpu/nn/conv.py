@@ -6,8 +6,13 @@ is one ``lax.conv_general_dilated`` call: XLA tiles it onto the MXU and
 fuses the bias/activation — there is no im2col buffer, no per-sample
 threading, no col2im backward (autodiff derives it).
 
-Layouts preserve Torch conventions for import parity: activations NCHW,
-weights OIHW, grouped conv via ``feature_group_count``.
+Layouts preserve Torch conventions for import parity at the API edge:
+weights are always stored OIHW and the default activation layout is NCHW.
+``data_format="NHWC"`` switches a layer's *activation* layout to the
+TPU-native channels-last form (the MXU wants NHWC; with NCHW the compiler
+inserts relayout ops around every conv).  Weight storage is unchanged, so
+.t7/Caffe import and the Torch oracles work identically in both modes —
+models opt in per-layer and transpose activations once at the model edge.
 """
 from __future__ import annotations
 
@@ -20,7 +25,17 @@ from jax import lax
 from bigdl_tpu.nn.initialization import Default, InitializationMethod
 from bigdl_tpu.nn.module import Module
 
-_DN = ("NCHW", "OIHW", "NCHW")
+
+def _dn(data_format: str):
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {data_format!r}")
+    return (data_format, "OIHW", data_format)
+
+
+def _add_bias(y, bias, data_format: str):
+    if data_format == "NCHW":
+        return y + bias[None, :, None, None]
+    return y + bias  # NHWC: channel is last, plain broadcast
 
 
 class SpatialConvolution(Module):
@@ -35,7 +50,8 @@ class SpatialConvolution(Module):
                  kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
                  pad_w: int = 0, pad_h: int = 0, n_group: int = 1,
                  propagate_back: bool = True, with_bias: bool = True,
-                 init_method: type[InitializationMethod] = Default):
+                 init_method: type[InitializationMethod] = Default,
+                 data_format: str = "NCHW"):
         super().__init__()
         assert n_input_plane % n_group == 0 and n_output_plane % n_group == 0
         self.n_input_plane = n_input_plane
@@ -49,6 +65,8 @@ class SpatialConvolution(Module):
         self.n_group = n_group
         self.with_bias = with_bias
         self.init_method = init_method
+        self.data_format = data_format
+        _dn(data_format)  # validate early
 
     def _fans(self):
         fan_in = self.n_input_plane // self.n_group * self.kernel_h * self.kernel_w
@@ -75,11 +93,11 @@ class SpatialConvolution(Module):
             x, params["weight"],
             window_strides=(self.stride_h, self.stride_w),
             padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
-            dimension_numbers=_DN,
+            dimension_numbers=_dn(self.data_format),
             feature_group_count=self.n_group,
         )
         if self.with_bias:
-            y = y + params["bias"][None, :, None, None]
+            y = _add_bias(y, params["bias"], self.data_format)
         return y[0] if squeeze else y
 
 
@@ -96,9 +114,11 @@ class SpatialDilatedConvolution(SpatialConvolution):
     def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
                  stride_w=1, stride_h=1, pad_w=0, pad_h=0,
                  dilation_w: int = 1, dilation_h: int = 1,
-                 init_method: type[InitializationMethod] = Default):
+                 init_method: type[InitializationMethod] = Default,
+                 data_format: str = "NCHW"):
         super().__init__(n_input_plane, n_output_plane, kernel_w, kernel_h,
-                         stride_w, stride_h, pad_w, pad_h, init_method=init_method)
+                         stride_w, stride_h, pad_w, pad_h,
+                         init_method=init_method, data_format=data_format)
         self.dilation_w = dilation_w
         self.dilation_h = dilation_h
 
@@ -111,10 +131,10 @@ class SpatialDilatedConvolution(SpatialConvolution):
             window_strides=(self.stride_h, self.stride_w),
             padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
             rhs_dilation=(self.dilation_h, self.dilation_w),
-            dimension_numbers=_DN,
+            dimension_numbers=_dn(self.data_format),
         )
         if self.with_bias:
-            y = y + params["bias"][None, :, None, None]
+            y = _add_bias(y, params["bias"], self.data_format)
         return y[0] if squeeze else y
 
 
@@ -129,8 +149,11 @@ class SpatialFullConvolution(Module):
                  kernel_w: int, kernel_h: int, stride_w: int = 1, stride_h: int = 1,
                  pad_w: int = 0, pad_h: int = 0, adj_w: int = 0, adj_h: int = 0,
                  n_group: int = 1, no_bias: bool = False,
-                 init_method: type[InitializationMethod] = Default):
+                 init_method: type[InitializationMethod] = Default,
+                 data_format: str = "NCHW"):
         super().__init__()
+        self.data_format = data_format
+        _dn(data_format)
         self.n_input_plane = n_input_plane
         self.n_output_plane = n_output_plane
         self.kernel_w = kernel_w
@@ -177,11 +200,11 @@ class SpatialFullConvolution(Module):
             x, w, window_strides=(1, 1),
             padding=(pad_h, pad_w),
             lhs_dilation=(self.stride_h, self.stride_w),
-            dimension_numbers=_DN,
+            dimension_numbers=_dn(self.data_format),
             feature_group_count=self.n_group,
         )
         if self.with_bias:
-            y = y + params["bias"][None, :, None, None]
+            y = _add_bias(y, params["bias"], self.data_format)
         return y[0] if squeeze else y
 
 
@@ -251,7 +274,7 @@ class SpatialConvolutionMap(Module):
             x, params["weight"] * self._mask,
             window_strides=(self.stride_h, self.stride_w),
             padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
-            dimension_numbers=_DN,
+            dimension_numbers=_dn("NCHW"),
         )
         y = y + params["bias"][None, :, None, None]
         return y[0] if squeeze else y

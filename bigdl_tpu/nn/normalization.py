@@ -26,12 +26,15 @@ class BatchNormalization(Module):
     _param_shape_from = "n_output"
 
     def __init__(self, n_output: int, eps: float = 1e-5, momentum: float = 0.1,
-                 affine: bool = True):
+                 affine: bool = True, data_format: str = "NCHW"):
         super().__init__()
         self.n_output = n_output
         self.eps = eps
         self.momentum = momentum
         self.affine = affine
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError(f"unsupported data_format {data_format!r}")
+        self.data_format = data_format
 
     def init(self, rng):
         if not self.affine:
@@ -43,16 +46,22 @@ class BatchNormalization(Module):
         return {"running_mean": jnp.zeros((self.n_output,)),
                 "running_var": jnp.ones((self.n_output,))}
 
+    def _channel_axis(self, ndim):
+        if ndim <= 2 or self.data_format == "NHWC":
+            return ndim - 1
+        return 1
+
     def _reshape_stat(self, s, ndim):
-        if ndim <= 2:
-            return s
+        ch = self._channel_axis(ndim)
+        if ch == ndim - 1:
+            return s  # broadcasts naturally on the last axis
         shape = [1] * ndim
-        shape[1] = self.n_output
+        shape[ch] = self.n_output
         return s.reshape(shape)
 
     def apply(self, params, x, *, buffers=None, training=False, rng=None):
         buffers = buffers or self.init_buffers()
-        axes = tuple(i for i in range(x.ndim) if i != (1 if x.ndim > 2 else x.ndim - 1))
+        axes = tuple(i for i in range(x.ndim) if i != self._channel_axis(x.ndim))
         if training:
             mean = jnp.mean(x, axis=axes)
             var = jnp.var(x, axis=axes)
@@ -103,21 +112,26 @@ class SpatialCrossMapLRN(Module):
     y = x / (k + alpha/size * sum_{window} x^2)^beta."""
 
     def __init__(self, size: int = 5, alpha: float = 1.0, beta: float = 0.75,
-                 k: float = 1.0):
+                 k: float = 1.0, data_format: str = "NCHW"):
         super().__init__()
         self.size = size
         self.alpha = alpha
         self.beta = beta
         self.k = k
+        self.data_format = data_format
 
     def f(self, params, x, **kw):
         half = (self.size - 1) // 2
         sq = jnp.square(x)
+        ch = 1 if self.data_format == "NCHW" else 3
+        dims, pads = [1] * 4, [(0, 0)] * 4
+        dims[ch] = self.size
+        pads[ch] = (half, self.size - 1 - half)
         window_sum = lax.reduce_window(
             sq, 0.0, lax.add,
-            window_dimensions=(1, self.size, 1, 1),
+            window_dimensions=tuple(dims),
             window_strides=(1, 1, 1, 1),
-            padding=((0, 0), (half, self.size - 1 - half), (0, 0), (0, 0)),
+            padding=tuple(pads),
         )
         return x * jnp.power(self.k + self.alpha / self.size * window_sum, -self.beta)
 

@@ -14,6 +14,25 @@ from bigdl_tpu.nn.module import Module
 from bigdl_tpu.utils.table import Table
 
 
+def _pool_geometry(x, kernel_h, kernel_w, stride_h, stride_w, pad_h, pad_w,
+                   ceil_mode, data_format):
+    """(window_dims, window_strides, paddings) for reduce_window in either
+    activation layout (spatial dims at 2,3 for NCHW; 1,2 for NHWC)."""
+    if data_format == "NCHW":
+        hd, wd = 2, 3
+    elif data_format == "NHWC":
+        hd, wd = 1, 2
+    else:
+        raise ValueError(f"unsupported data_format {data_format!r}")
+    _, ph = _pool_pads(x.shape[hd], kernel_h, stride_h, pad_h, ceil_mode)
+    _, pw = _pool_pads(x.shape[wd], kernel_w, stride_w, pad_w, ceil_mode)
+    dims, strides, pads = [1] * 4, [1] * 4, [(0, 0)] * 4
+    dims[hd], dims[wd] = kernel_h, kernel_w
+    strides[hd], strides[wd] = stride_h, stride_w
+    pads[hd], pads[wd] = ph, pw
+    return tuple(dims), tuple(strides), tuple(pads)
+
+
 def _pool_pads(size, kernel, stride, pad, ceil_mode):
     """Torch-style output sizing: floor or ceil mode; in ceil mode the last
     window must start inside the (padded) input (Torch SpatialMaxPooling
@@ -30,7 +49,8 @@ def _pool_pads(size, kernel, stride, pad, ceil_mode):
 
 class SpatialMaxPooling(Module):
     def __init__(self, kernel_w: int, kernel_h: int, stride_w: int = None,
-                 stride_h: int = None, pad_w: int = 0, pad_h: int = 0):
+                 stride_h: int = None, pad_w: int = 0, pad_h: int = 0,
+                 data_format: str = "NCHW"):
         super().__init__()
         self.kernel_w = kernel_w
         self.kernel_h = kernel_h
@@ -39,6 +59,7 @@ class SpatialMaxPooling(Module):
         self.pad_w = pad_w
         self.pad_h = pad_h
         self.ceil_mode = False
+        self.data_format = data_format
 
     def ceil(self) -> "SpatialMaxPooling":
         self.ceil_mode = True
@@ -52,14 +73,10 @@ class SpatialMaxPooling(Module):
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
-        _, ph = _pool_pads(x.shape[2], self.kernel_h, self.stride_h, self.pad_h, self.ceil_mode)
-        _, pw = _pool_pads(x.shape[3], self.kernel_w, self.stride_w, self.pad_w, self.ceil_mode)
-        y = lax.reduce_window(
-            x, -jnp.inf, lax.max,
-            window_dimensions=(1, 1, self.kernel_h, self.kernel_w),
-            window_strides=(1, 1, self.stride_h, self.stride_w),
-            padding=((0, 0), (0, 0), ph, pw),
-        )
+        dims, strides, pads = _pool_geometry(
+            x, self.kernel_h, self.kernel_w, self.stride_h, self.stride_w,
+            self.pad_h, self.pad_w, self.ceil_mode, self.data_format)
+        y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pads)
         return y[0] if squeeze else y
 
 
@@ -67,7 +84,7 @@ class SpatialAveragePooling(Module):
     def __init__(self, kernel_w: int, kernel_h: int, stride_w: int = None,
                  stride_h: int = None, pad_w: int = 0, pad_h: int = 0,
                  ceil_mode: bool = False, count_include_pad: bool = True,
-                 divide: bool = True):
+                 divide: bool = True, data_format: str = "NCHW"):
         super().__init__()
         self.kernel_w = kernel_w
         self.kernel_h = kernel_h
@@ -78,16 +95,15 @@ class SpatialAveragePooling(Module):
         self.ceil_mode = ceil_mode
         self.count_include_pad = count_include_pad
         self.divide = divide
+        self.data_format = data_format
 
     def f(self, params, x, **kw):
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
-        _, ph = _pool_pads(x.shape[2], self.kernel_h, self.stride_h, self.pad_h, self.ceil_mode)
-        _, pw = _pool_pads(x.shape[3], self.kernel_w, self.stride_w, self.pad_w, self.ceil_mode)
-        dims = (1, 1, self.kernel_h, self.kernel_w)
-        strides = (1, 1, self.stride_h, self.stride_w)
-        pads = ((0, 0), (0, 0), ph, pw)
+        dims, strides, pads = _pool_geometry(
+            x, self.kernel_h, self.kernel_w, self.stride_h, self.stride_w,
+            self.pad_h, self.pad_w, self.ceil_mode, self.data_format)
         y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pads)
         if self.divide:
             if self.count_include_pad:
