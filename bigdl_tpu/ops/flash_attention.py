@@ -5,9 +5,12 @@ innermost grid dimension streams (block_k, d) K/V tiles from HBM through
 VMEM while per-q-block accumulators (acc, m, l) live in VMEM scratch, so
 neither the (T, T) score matrix nor the full K/V ever needs to be resident
 — sequence length is bounded by HBM, not VMEM.  Causal and padded key
-blocks are skipped with predicated execution.  Backward recomputes
-probabilities from the saved logsumexp — the standard flash recomputation
-— as one fused XLA expression.
+blocks are skipped with predicated execution.  Backward is the same tiled
+recomputation as two Pallas kernels (dk/dv accumulated over query blocks;
+dq accumulated over key blocks) from the saved logsumexp — like the
+forward, nothing of size (T, T) is ever materialized, so long-context
+training is HBM-bound too (an XLA einsum backward would OOM exactly where
+flash attention is supposed to win).
 
 Cross-attention (Tq != Tk) aligns causality bottom-right (query i attends
 key j iff j - Tk <= i - Tq), matching ``dot_product_attention``.
@@ -155,6 +158,187 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     return o[:, :, :tq], lse[:, :, :tq]
 
 
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale: float, causal: bool, tq_real: int, tk_real: int,
+                    block_q: int, block_k: int):
+    """Grid (B, H, n_k, n_q), query blocks innermost: one (block_k, d)
+    dk/dv pair accumulates in VMEM scratch while (block_q, d) q/do tiles
+    stream past — the mirror image of the forward's streaming direction."""
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    n_q = pl.num_programs(3)
+    d = q_ref.shape[3]
+
+    @pl.when(iq == 0)
+    def _():
+        dk_acc[:] = jnp.zeros((block_k, d), jnp.float32)
+        dv_acc[:] = jnp.zeros((block_k, d), jnp.float32)
+
+    q_end = iq * block_q + block_q - 1 + (tk_real - tq_real)
+    block_live = jnp.logical_and(
+        jnp.logical_and(ik * block_k < tk_real,   # not pure key padding
+                        iq * block_q < tq_real),  # not pure query padding
+        jnp.logical_or(not causal, q_end >= ik * block_k))
+
+    @pl.when(block_live)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        rest = (delta_ref[0, 0] - dlse_ref[0, 0])[:, None]
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ik * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = jnp.logical_and(q_pos < tq_real, k_pos < tk_real)
+        if causal:
+            mask = jnp.logical_and(mask, q_pos + (tk_real - tq_real) >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - rest)
+        dk_acc[:] += jnp.dot(ds.T, q,
+                             preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == n_q - 1)
+    def _():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dlse_ref, dq_ref, dq_acc, *,
+                   scale: float, causal: bool, tq_real: int, tk_real: int,
+                   block_q: int, block_k: int):
+    """Grid (B, H, n_q, n_k), key blocks innermost: dq for one query block
+    accumulates in scratch while K/V tiles stream past (same streaming
+    direction as the forward)."""
+    iq = pl.program_id(2)
+    j = pl.program_id(3)
+    n_k = pl.num_programs(3)
+    d = q_ref.shape[3]
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros((block_q, d), jnp.float32)
+
+    q_end = iq * block_q + block_q - 1 + (tk_real - tq_real)
+    block_live = jnp.logical_and(
+        jnp.logical_and(j * block_k < tk_real, iq * block_q < tq_real),
+        jnp.logical_or(not causal, j * block_k <= q_end))
+
+    @pl.when(block_live)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        kb = k_ref[0, 0].astype(jnp.float32)
+        vb = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        rest = (delta_ref[0, 0] - dlse_ref[0, 0])[:, None]
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = jnp.logical_and(q_pos < tq_real, k_pos < tk_real)
+        if causal:
+            mask = jnp.logical_and(mask, q_pos + (tk_real - tq_real) >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - rest)
+        dq_acc[:] += jnp.dot(ds, kb,
+                             preferred_element_type=jnp.float32) * scale
+
+    @pl.when(j == n_k - 1)
+    def _():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _pad1_t(x, block):
+    t = x.shape[2]
+    rem = t % block
+    if rem == 0:
+        return x
+    return jnp.pad(x, [(0, 0), (0, 0), (0, block - rem)])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "scale", "block_q", "block_k", "interpret"))
+def _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k,
+               interpret):
+    """Tiled backward: dq, dk, dv with nothing of size (Tq, Tk) resident.
+    ``delta = rowsum(do * o)`` is the standard flash backward scalar; the
+    optional lse cotangent folds in as ``ds += p * dlse``."""
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    qp, dop = _pad_t(q, block_q), _pad_t(do, block_q)
+    kp, vp = _pad_t(k, block_k), _pad_t(v, block_k)
+    lsep = _pad1_t(lse, block_q)
+    deltap = _pad1_t(delta, block_q)
+    dlsep = _pad1_t(dlse.astype(jnp.float32), block_q)
+    tq_pad, tk_pad = qp.shape[2], kp.shape[2]
+    n_q, n_k = tq_pad // block_q, tk_pad // block_k
+
+    qspec = pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, oi, ii: (bi, hi, ii, 0))
+    kspec_o = pl.BlockSpec((1, 1, block_k, d),
+                           lambda bi, hi, oi, ii: (bi, hi, oi, 0))
+    rowspec = pl.BlockSpec((1, 1, block_q),
+                           lambda bi, hi, oi, ii: (bi, hi, ii))
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, scale=scale, causal=causal, tq_real=tq, tk_real=tk,
+        block_q=block_q, block_k=block_k)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b, h, n_k, n_q),  # query blocks innermost
+        in_specs=[qspec, kspec_o, kspec_o, qspec, rowspec, rowspec, rowspec],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, oi, ii: (bi, hi, oi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, oi, ii: (bi, hi, oi, 0)),
+        ],
+        out_shape=[
+            _sds((b, h, tk_pad, d), k.dtype, q, k, v, do),
+            _sds((b, h, tk_pad, d), v.dtype, q, k, v, do),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap, dlsep)
+
+    qspec2 = pl.BlockSpec((1, 1, block_q, d),
+                          lambda bi, hi, oi, ii: (bi, hi, oi, 0))
+    kspec2 = pl.BlockSpec((1, 1, block_k, d),
+                          lambda bi, hi, oi, ii: (bi, hi, ii, 0))
+    rowspec2 = pl.BlockSpec((1, 1, block_q),
+                            lambda bi, hi, oi, ii: (bi, hi, oi))
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, scale=scale, causal=causal, tq_real=tq, tk_real=tk,
+        block_q=block_q, block_k=block_k)
+    (dq,) = pl.pallas_call(
+        dq_kernel,
+        grid=(b, h, n_q, n_k),  # key blocks innermost
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2,
+                  rowspec2],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, oi, ii: (bi, hi, oi, 0)),
+        ],
+        out_shape=[_sds((b, h, tq_pad, d), q.dtype, q, k, v, do)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lsep, deltap, dlsep)
+    return dq[:, :, :tq], dk[:, :, :tk], dv[:, :, :tk]
+
+
 def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -172,10 +356,12 @@ def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_core(causal, scale, res, do, dlse=None):
-    """Recomputation backward shared by both VJPs.  With ``dlse`` (the
-    cotangent of the logsumexp output): d lse_i / d s_ij = p_ij, so it
-    adds ``p * dlse`` to the score cotangent."""
+def _flash_bwd_reference(causal, scale, res, do, dlse=None):
+    """O(Tq*Tk) XLA recomputation backward — kept ONLY as the correctness
+    oracle for the tiled kernel (tests compare the two); the VJPs below use
+    the Pallas ``_flash_bwd``.  With ``dlse`` (the cotangent of the
+    logsumexp output): d lse_i / d s_ij = p_ij, so it adds ``p * dlse`` to
+    the score cotangent."""
     q, k, v, o, lse = res
     q32, k32, v32 = (x.astype(jnp.float32) for x in (q, k, v))
     do32, o32 = do.astype(jnp.float32), o.astype(jnp.float32)
@@ -200,7 +386,10 @@ def _flash_bwd_core(causal, scale, res, do, dlse=None):
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
-    return _flash_bwd_core(causal, scale, res, do)
+    q, k, v, o, lse = res
+    dlse = jnp.zeros(lse.shape, jnp.float32)
+    return _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale,
+                      block_q, block_k, _use_interpret())
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -220,7 +409,9 @@ def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
 
 def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, res, cts):
     do, dlse = cts
-    return _flash_bwd_core(causal, scale, res, do, dlse)
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale,
+                      block_q, block_k, _use_interpret())
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
