@@ -4,12 +4,22 @@ reference repo's only benchmark suite).
 
     python -m bigdl_tpu.models.utils.perf -m inception_v1 -b 32 -i 20
     python -m bigdl_tpu.models.utils.perf -m resnet50 --distributed
+    python -m bigdl_tpu.models.utils.perf -m resnet50 --mesh 1,2,4,8 \
+        -b 8 -i 5 --json scaling.json
 
-Prints per-iteration and steady-state records/s.
+Prints per-iteration and steady-state records/s.  ``--mesh`` runs the
+scaling-efficiency sweep (BASELINE.md's second metric: >= 90% efficiency
+8 -> 64 chips): weak scaling with a fixed per-chip batch over data-parallel
+meshes of each size, reporting per-step time, weak-scaling efficiency
+vs the smallest mesh, and the overhead share the mesh adds.  On a 1-TPU
+dev box the sweep runs on forced virtual CPU devices — the numbers then
+validate the *measurement path*, not ICI; the same command on a pod
+measures the real thing and the JSON is what you commit.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import time
 
 import numpy as np
@@ -27,58 +37,61 @@ MODELS = {
 }
 
 
-def build_model(name: str):
+def build_model(name: str, data_format: str = "NCHW"):
     from bigdl_tpu import models
+    df = data_format
+    if name in ("lenet5", "alexnet") and df != "NCHW":
+        raise ValueError(f"{name} supports NCHW only")
     if name == "lenet5":
         return models.LeNet5(10)
     if name == "alexnet":
         return models.AlexNet(1000)
     if name == "inception_v1":
-        return models.Inception_v1(1000)
+        return models.Inception_v1(1000, data_format=df)
     if name == "inception_v2":
-        return models.Inception_v2(1000)
+        return models.Inception_v2(1000, data_format=df)
     if name == "vgg16":
-        return models.Vgg_16(1000)
+        return models.Vgg_16(1000, data_format=df)
     if name == "vgg19":
-        return models.Vgg_19(1000)
+        return models.Vgg_19(1000, data_format=df)
     if name == "resnet50":
-        return models.ResNet(1000, depth=50, dataset="imagenet")
+        return models.ResNet(1000, depth=50, dataset="imagenet", data_format=df)
     if name == "vgg_cifar":
-        return models.VggForCifar10(10)
+        return models.VggForCifar10(10, data_format=df)
     raise ValueError(f"unknown model {name}; choose from {sorted(MODELS)}")
 
 
-def run_perf(model_name: str, batch_size: int, iterations: int,
-             distributed: bool = False, data_type: str = "random",
-             warmup: int = 3, dtype="float32") -> dict:
-    import jax
-    import jax.numpy as jnp
-    from bigdl_tpu import nn
+def _sample_shape(model_name: str, data_format: str):
+    kind, size = MODELS[model_name]
+    channels = 1 if kind == "mnist" else 3
+    if model_name == "lenet5":
+        return (1, 28, 28), 10
+    n_classes = 10 if kind in ("mnist", "cifar") else 1000
+    shape = ((size, size, channels) if data_format == "NHWC"
+             else (channels, size, size))
+    return shape, n_classes
+
+
+def _make_dataset(model_name: str, batch_size: int, data_type: str,
+                  data_format: str):
     from bigdl_tpu.dataset import DataSet, Sample
     from bigdl_tpu.dataset.transformer import SampleToBatch
-    from bigdl_tpu.optim import SGD, Trigger, LocalOptimizer
-    from bigdl_tpu.parallel import DistriOptimizer
 
-    kind, size = MODELS[model_name]
+    shape, n_classes = _sample_shape(model_name, data_format)
     rng = np.random.RandomState(0)
-    n_classes = 10 if kind in ("mnist", "cifar") else 1000
-    channels = 1 if kind == "mnist" else 3
-    shape = (channels, size, size) if model_name != "lenet5" else (1, 28, 28)
 
     def gen():
         if data_type == "constant":
             return np.ones(shape, np.float32)
         return rng.randn(*shape).astype(np.float32)
 
-    samples = [Sample(gen(), np.asarray(float(i % n_classes) + 1, dtype=np.float32))
+    samples = [Sample(gen(), np.asarray(float(i % n_classes) + 1,
+                                        dtype=np.float32))
                for i in range(batch_size * 2)]
-    ds = DataSet.array(samples) >> SampleToBatch(batch_size, drop_last=True)
-    model = build_model(model_name).build(seed=1)
-    cls = DistriOptimizer if distributed else LocalOptimizer
-    opt = cls(model, ds, nn.ClassNLLCriterion())
-    opt.set_optim_method(SGD(learning_rate=0.01)) \
-       .set_end_when(Trigger.max_iteration(warmup + iterations))
+    return DataSet.array(samples) >> SampleToBatch(batch_size, drop_last=True)
 
+
+def _capture_step_times(opt) -> list:
     times: list[float] = []
     orig_add = opt.metrics.add
 
@@ -87,7 +100,24 @@ def run_perf(model_name: str, batch_size: int, iterations: int,
             times.append(value)
         orig_add(name, value)
     opt.metrics.add = capture
+    return times
 
+
+def run_perf(model_name: str, batch_size: int, iterations: int,
+             distributed: bool = False, data_type: str = "random",
+             warmup: int = 3, dtype="float32",
+             data_format: str = "NCHW") -> dict:
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import SGD, Trigger, LocalOptimizer
+    from bigdl_tpu.parallel import DistriOptimizer
+
+    ds = _make_dataset(model_name, batch_size, data_type, data_format)
+    model = build_model(model_name, data_format).build(seed=1)
+    cls = DistriOptimizer if distributed else LocalOptimizer
+    opt = cls(model, ds, nn.ClassNLLCriterion())
+    opt.set_optim_method(SGD(learning_rate=0.01)) \
+       .set_end_when(Trigger.max_iteration(warmup + iterations))
+    times = _capture_step_times(opt)
     opt.optimize()
     steady = times[warmup:]
     throughput = batch_size / (sum(steady) / len(steady))
@@ -96,18 +126,87 @@ def run_perf(model_name: str, batch_size: int, iterations: int,
             "mean_step_s": sum(steady) / len(steady)}
 
 
+def run_scaling_sweep(model_name: str, per_chip_batch: int, iterations: int,
+                      mesh_sizes: list, data_type: str = "random",
+                      warmup: int = 2, data_format: str = "NCHW") -> dict:
+    """Weak-scaling sweep (ref DistriOptimizerPerf's role; target metric
+    BASELINE.md 'allreduce scaling eff').  Fixed per-chip batch; global
+    batch grows with the mesh.  efficiency(N) = t_step(N0) / t_step(N) —
+    1.0 is perfect weak scaling; the gap is collective + overhead share."""
+    from bigdl_tpu.utils.engine import ensure_virtual_devices
+    devices = ensure_virtual_devices(max(mesh_sizes))
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+    from bigdl_tpu.parallel.mesh import DATA_AXIS
+
+    rows = []
+    for n in sorted(mesh_sizes):
+        mesh = create_mesh({DATA_AXIS: n}, devices=devices[:n])
+        global_batch = per_chip_batch * n
+        ds = _make_dataset(model_name, global_batch, data_type, data_format)
+        model = build_model(model_name, data_format).build(seed=1)
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), mesh=mesh)
+        opt.set_optim_method(SGD(learning_rate=0.01)) \
+           .set_end_when(Trigger.max_iteration(warmup + iterations))
+        times = _capture_step_times(opt)
+        opt.optimize()
+        steady = times[warmup:]
+        mean_step = sum(steady) / len(steady)
+        rows.append({"mesh": n, "global_batch": global_batch,
+                     "mean_step_s": mean_step,
+                     "records_s": global_batch / mean_step,
+                     "records_s_per_chip": per_chip_batch / mean_step})
+    base = rows[0]["mean_step_s"]
+    for r in rows:
+        r["efficiency"] = base / r["mean_step_s"]
+        r["overhead_share"] = max(0.0, 1.0 - r["efficiency"])
+    out = {"model": model_name, "per_chip_batch": per_chip_batch,
+           "data_format": data_format, "iterations": iterations,
+           "platform": devices[0].platform,
+           "sweep": rows}
+    if devices[0].platform == "cpu":
+        out["note"] = ("virtual CPU devices share the host's physical "
+                       "cores: efficiency here validates the measurement "
+                       "path, not ICI scaling — run on a pod for the "
+                       "BASELINE.md metric")
+    return out
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="Synthetic throughput benchmark")
     p.add_argument("-m", "--model", default="inception_v1", choices=sorted(MODELS))
-    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("-b", "--batchSize", type=int, default=32,
+                   help="batch size (per chip in --mesh mode)")
     p.add_argument("-i", "--iteration", type=int, default=20)
     p.add_argument("-t", "--dataType", default="random", choices=["random", "constant"])
+    p.add_argument("--dataFormat", default="NCHW", choices=["NCHW", "NHWC"],
+                   help="activation layout (NHWC = TPU-fast channels-last)")
     p.add_argument("--distributed", action="store_true")
+    p.add_argument("--mesh", default=None,
+                   help="comma-separated mesh sizes for the scaling sweep, "
+                        "e.g. 1,2,4,8")
+    p.add_argument("--json", default=None,
+                   help="write the result as JSON to this path")
     args = p.parse_args(argv)
-    result = run_perf(args.model, args.batchSize, args.iteration,
-                      distributed=args.distributed, data_type=args.dataType)
-    print(f"{result['model']}: {result['throughput_rec_s']:.1f} records/s "
-          f"({result['mean_step_s']*1000:.1f} ms/step, batch {result['batch_size']})")
+    if args.mesh:
+        sizes = [int(s) for s in args.mesh.split(",")]
+        result = run_scaling_sweep(args.model, args.batchSize, args.iteration,
+                                   sizes, data_type=args.dataType,
+                                   data_format=args.dataFormat)
+        for r in result["sweep"]:
+            print(f"mesh {r['mesh']:>3}: {r['mean_step_s']*1000:8.1f} ms/step, "
+                  f"{r['records_s']:9.1f} records/s, "
+                  f"efficiency {r['efficiency']*100:6.1f}%")
+    else:
+        result = run_perf(args.model, args.batchSize, args.iteration,
+                          distributed=args.distributed, data_type=args.dataType,
+                          data_format=args.dataFormat)
+        print(f"{result['model']}: {result['throughput_rec_s']:.1f} records/s "
+              f"({result['mean_step_s']*1000:.1f} ms/step, batch {result['batch_size']})")
+    if args.json:
+        from bigdl_tpu.utils import fs
+        fs.atomic_write(args.json, json.dumps(result, indent=2).encode())
 
 
 if __name__ == "__main__":

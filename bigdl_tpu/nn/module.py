@@ -286,9 +286,12 @@ class Module:
         return self
 
     @staticmethod
-    def load(path: str) -> "Module":
+    def load(path: str, template: "Optional[Module]" = None) -> "Module":
+        """Load a saved module.  Pass ``template`` (a code-constructed
+        instance of the architecture) to restore arrays into it without
+        consulting the checkpoint's class names — immune to renames."""
         from bigdl_tpu.utils import file_io
-        return file_io.load_module(path)
+        return file_io.load_module(path, template=template)
 
     def save_torch(self, path: str, overwrite: bool = False) -> "Module":
         """Write a Torch7-readable .t7 (ref AbstractModule.saveTorch)."""

@@ -18,6 +18,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.nn.module import Criterion, Module
@@ -64,8 +65,14 @@ class Optimizer:
         return self
 
     def set_checkpoint(self, path: str, trigger: Trigger) -> "Optimizer":
-        if not os.path.isdir(path):
-            raise ValueError(f"checkpoint path {path} is not a directory")
+        """Checkpoint dir may be local or remote (gs://, memory://, ...);
+        local dirs are created, remote schemes are flat keyspaces."""
+        from bigdl_tpu.utils import fs as _fs
+        filesystem, rest = _fs.get_filesystem(path)
+        if isinstance(filesystem, _fs.LocalFileSystem):
+            if os.path.exists(rest) and not os.path.isdir(rest):
+                raise ValueError(f"checkpoint path {path} is not a directory")
+            filesystem.makedirs(rest)
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger
         return self
@@ -75,6 +82,7 @@ class Optimizer:
         self.validation_trigger = trigger
         self.validation_dataset = dataset
         self.validation_methods = methods
+        self._validator = None  # rebuilt around the new dataset
         return self
 
     def set_train_summary(self, summary) -> "Optimizer":
@@ -174,16 +182,19 @@ class Optimizer:
 
     def _checkpoint(self):
         """Write model.<neval> + state.<neval> (ref Optimizer.saveModel/
-        saveState, DistriOptimizer.scala:334-356)."""
-        from bigdl_tpu.utils import file_io
+        saveState, DistriOptimizer.scala:334-356).  Paths flow through the
+        fs layer, so gs://... checkpoint dirs work from pod workers (the
+        reference's hdfs: support, utils/File.scala:62-122)."""
+        from bigdl_tpu.utils import file_io, fs
         n = self.state["neval"] - 1
-        self.model.save(os.path.join(self.checkpoint_path, f"model.{n}"), overwrite=True)
+        self.model.save(fs.join(self.checkpoint_path, f"model.{n}"),
+                        overwrite=True)
         opt_state = getattr(self.optim_method, "_state", None)
         host_state = dict(self.state)
         file_io.save({"driver_state": host_state,
                       "optim_state": jax.tree_util.tree_map(
-                          lambda a: a, opt_state) if opt_state is not None else None},
-                     os.path.join(self.checkpoint_path, f"state.{n}"), overwrite=True)
+                          np.asarray, opt_state) if opt_state is not None else None},
+                     fs.join(self.checkpoint_path, f"state.{n}"), overwrite=True)
         log.info("checkpoint written at iteration %d", n)
 
 
@@ -320,8 +331,10 @@ class LocalOptimizer(Optimizer):
         return model
 
     def _validate(self):
-        return LocalValidator(self.model, self.validation_dataset).test(
-            self.validation_methods)
+        if getattr(self, "_validator", None) is None:
+            self._validator = LocalValidator(self.model,
+                                             self.validation_dataset)
+        return self._validator.test(self.validation_methods)
 
 
 class Validator:
@@ -330,6 +343,20 @@ class Validator:
     def __init__(self, model: Module, dataset: AbstractDataSet):
         self.model = model
         self.dataset = dataset
+        self._fwd = None  # jitted forward, built once: validation runs
+        # every epoch and a fresh jit wrapper per call would recompile
+
+    def _jitted_fwd(self):
+        if self._fwd is None:
+            model = self.model
+
+            def fwd(params, buffers, data):
+                out, _ = model.apply(params, data, buffers=buffers,
+                                     training=False)
+                return out
+
+            self._fwd = jax.jit(fwd)
+        return self._fwd
 
 
 class LocalValidator(Validator):
@@ -339,12 +366,7 @@ class LocalValidator(Validator):
     def test(self, methods: Sequence[ValidationMethod]):
         model = self.model
         model._built()
-
-        @jax.jit
-        def fwd(params, buffers, data):
-            out, _ = model.apply(params, data, buffers=buffers, training=False)
-            return out
-
+        fwd = self._jitted_fwd()
         totals = [None] * len(methods)
         for batch in self.dataset.data(train=False):
             out = fwd(model.params, model.buffers, jnp.asarray(batch.data))

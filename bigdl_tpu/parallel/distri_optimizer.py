@@ -226,8 +226,10 @@ class DistriOptimizer(Optimizer):
         return self.model
 
     def _validate(self):
-        return DistriValidator(self.model, self.validation_dataset,
-                               self.mesh).test(self.validation_methods)
+        if getattr(self, "_validator", None) is None:
+            self._validator = DistriValidator(
+                self.model, self.validation_dataset, self.mesh)
+        return self._validator.test(self.validation_methods)
 
 
 class DistriValidator(Validator):
@@ -244,12 +246,7 @@ class DistriValidator(Validator):
         model = self.model
         model._built()
         repl = NamedSharding(self.mesh, P())
-
-        @partial(jax.jit, static_argnums=())
-        def fwd(params, buffers, data):
-            out, _ = model.apply(params, data, buffers=buffers, training=False)
-            return out
-
+        fwd = self._jitted_fwd()
         params = jax.device_put(model.params, repl)
         buffers = jax.device_put(model.buffers, repl)
         totals = [None] * len(methods)

@@ -22,6 +22,7 @@ from __future__ import annotations
 import os
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FuturesTimeoutError
 from typing import Callable, Optional, Sequence
 
 
@@ -50,13 +51,26 @@ class ThreadPool:
 
     def invoke_and_wait2(self, tasks: Sequence[Callable], timeout: Optional[float] = None) -> list[Future]:
         """Submit all tasks, wait up to ``timeout`` seconds; returns futures
-        (some possibly unfinished — the caller decides what to drop)."""
+        (some possibly unfinished — the caller decides what to drop).
+
+        Only *timeouts* are swallowed (that is the straggler-drop
+        semantic); a task that raised re-raises here after every other
+        task has been waited on — a worker dying with a real error is a
+        bug, not a straggler (the reference distinguishes the two the
+        same way: invokeAll returns, then Future.get rethrows)."""
         futures = self.invoke(tasks)
+        first_error: Optional[Exception] = None
         for f in futures:
             try:
                 f.result(timeout=timeout)
-            except Exception:  # noqa: BLE001 - timeout or task error: caller inspects
-                pass
+            except FuturesTimeoutError:
+                pass  # straggler: caller inspects f.done() and drops it
+            except Exception as e:  # task failure (KeyboardInterrupt et al.
+                # propagate immediately — don't hold Ctrl-C hostage)
+                if first_error is None:
+                    first_error = e
+        if first_error is not None:
+            raise first_error
         return futures
 
     def sync(self, futures: Sequence[Future]) -> None:
@@ -79,6 +93,47 @@ class _EngineState:
 
 
 _state = _EngineState()
+
+
+def ensure_virtual_devices(n: int):
+    """Return >= ``n`` devices, forcing virtual CPU devices when the host
+    has fewer real chips (the analog of the reference's simulated-multinode
+    trick: DistriOptimizerSpec runs 4 "nodes" as 4 partitions in one
+    local[1] JVM, optim/DistriOptimizerSpec.scala:39-43).
+
+    ``--xla_force_host_platform_device_count`` only takes effect if set
+    before the first backend initialisation in the process, hence the env
+    mutation before any ``jax.devices()`` call.  Used by the driver's
+    ``dryrun_multichip`` and the perf scaling sweep."""
+    import re
+
+    want = max(8, n)
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None or int(m.group(1)) < want:
+        if m is not None:
+            flags = flags.replace(m.group(0), "")
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={want}").strip()
+    import jax
+
+    devices = list(jax.devices())
+    if len(devices) < n:
+        try:
+            devices = list(jax.devices("cpu"))
+        except RuntimeError as e:
+            raise RuntimeError(
+                f"need {n} devices and the cpu fallback backend is "
+                f"unavailable — a jax backend was initialised before this "
+                f"call, so XLA_FLAGS was set too late; restart and request "
+                f"the virtual devices before any other jax use.") from e
+    if len(devices) < n:
+        raise RuntimeError(
+            f"need {n} devices; have {len(devices)} CPU virtual devices. "
+            f"If a jax backend was initialised before this call, XLA_FLAGS "
+            f"was set too late — restart and request the virtual devices "
+            f"before any other jax use.")
+    return devices[:n]
 
 
 class Engine:

@@ -1,0 +1,208 @@
+"""Pluggable filesystem layer: scheme-dispatched IO for checkpoints and
+model files (ref utils/File.scala:62-122, whose save/load transparently
+handle ``hdfs:`` URIs — the TPU-cloud equivalents are ``gs://`` object
+stores, reached here through fsspec).
+
+Built-ins:
+  - local paths (no scheme or ``file://``)
+  - ``memory://`` — an in-process store, the mock remote FS for tests
+  - any other scheme (``gs://``, ``hdfs://``, ``s3://``) falls through to
+    fsspec when installed; ``register_filesystem`` overrides per scheme.
+
+Real pod training cannot checkpoint to a worker's local disk — every
+checkpoint path in bigdl_tpu flows through this module so a ``gs://``
+destination works end-to-end.
+"""
+from __future__ import annotations
+
+import io
+import os
+import threading
+from typing import BinaryIO, Optional
+
+
+def _split_scheme(path: str) -> tuple[str, str]:
+    """('gs', 'bucket/dir/f') for 'gs://bucket/dir/f'; ('', path) for local.
+    Windows drive letters ('C:/x') are not treated as schemes."""
+    idx = path.find("://")
+    if idx <= 1:  # no scheme, or single-letter drive
+        return "", path
+    return path[:idx], path[idx + 3:]
+
+
+class FileSystem:
+    """Minimal interface the framework needs: streams + a few queries."""
+
+    def open(self, path: str, mode: str = "rb") -> BinaryIO:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def remove(self, path: str) -> None:
+        raise NotImplementedError
+
+    def rename(self, src: str, dst: str) -> None:
+        """Replace dst with src (atomic where the backend supports it)."""
+        raise NotImplementedError
+
+
+class LocalFileSystem(FileSystem):
+    def open(self, path: str, mode: str = "rb") -> BinaryIO:
+        if "w" in mode or "a" in mode:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+        return open(path, mode)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def remove(self, path: str) -> None:
+        os.remove(path)
+
+    def rename(self, src: str, dst: str) -> None:
+        os.replace(src, dst)
+
+
+class MemoryFileSystem(FileSystem):
+    """In-process blob store keyed by full path — the mocked remote
+    filesystem used by tests (and handy as a scratch store)."""
+
+    def __init__(self):
+        self._blobs: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    class _Writer(io.BytesIO):
+        def __init__(self, fs: "MemoryFileSystem", path: str):
+            super().__init__()
+            self._fs = fs
+            self._path = path
+
+        def close(self):
+            with self._fs._lock:
+                self._fs._blobs[self._path] = self.getvalue()
+            super().close()
+
+    def open(self, path: str, mode: str = "rb") -> BinaryIO:
+        if "w" in mode:
+            return MemoryFileSystem._Writer(self, path)
+        with self._lock:
+            if path not in self._blobs:
+                raise FileNotFoundError(f"memory://{path}")
+            return io.BytesIO(self._blobs[path])
+
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            return path in self._blobs
+
+    def makedirs(self, path: str) -> None:
+        pass  # flat keyspace, like object stores
+
+    def remove(self, path: str) -> None:
+        with self._lock:
+            del self._blobs[path]
+
+    def rename(self, src: str, dst: str) -> None:
+        with self._lock:
+            self._blobs[dst] = self._blobs.pop(src)
+
+
+class FsspecFileSystem(FileSystem):
+    """Adapter for any fsspec-supported scheme (gs, s3, hdfs, ...)."""
+
+    def __init__(self, scheme: str):
+        import fsspec
+
+        self._scheme = scheme
+        self._fs = fsspec.filesystem(scheme)
+
+    def open(self, path: str, mode: str = "rb") -> BinaryIO:
+        return self._fs.open(f"{self._scheme}://{path}", mode)
+
+    def exists(self, path: str) -> bool:
+        return self._fs.exists(f"{self._scheme}://{path}")
+
+    def makedirs(self, path: str) -> None:
+        self._fs.makedirs(f"{self._scheme}://{path}", exist_ok=True)
+
+    def remove(self, path: str) -> None:
+        self._fs.rm(f"{self._scheme}://{path}")
+
+    def rename(self, src: str, dst: str) -> None:
+        self._fs.mv(f"{self._scheme}://{src}", f"{self._scheme}://{dst}")
+
+
+_local = LocalFileSystem()
+_registry: dict[str, FileSystem] = {
+    "": _local,
+    "file": _local,
+    "memory": MemoryFileSystem(),
+}
+
+
+def register_filesystem(scheme: str, fs: FileSystem) -> None:
+    """Install (or override) the filesystem serving ``scheme://`` paths."""
+    _registry[scheme] = fs
+
+
+def get_filesystem(path: str) -> tuple[FileSystem, str]:
+    """Resolve a path to (filesystem, scheme-stripped path); adapters that
+    need the scheme (fsspec) re-attach it themselves."""
+    scheme, rest = _split_scheme(path)
+    if scheme in _registry:
+        return _registry[scheme], rest
+    try:
+        fs = FsspecFileSystem(scheme)
+    except Exception as e:  # fsspec missing or scheme unknown
+        raise ValueError(
+            f"no filesystem registered for scheme {scheme!r} "
+            f"(register one with bigdl_tpu.utils.fs.register_filesystem)") from e
+    _registry[scheme] = fs
+    return fs, rest
+
+
+def open_file(path: str, mode: str = "rb") -> BinaryIO:
+    fs, p = get_filesystem(path)
+    return fs.open(p, mode)
+
+
+def exists(path: str) -> bool:
+    fs, p = get_filesystem(path)
+    return fs.exists(p)
+
+
+def makedirs(path: str) -> None:
+    fs, p = get_filesystem(path)
+    fs.makedirs(p)
+
+
+def remove(path: str) -> None:
+    fs, p = get_filesystem(path)
+    fs.remove(p)
+
+
+def join(base: str, *parts: str) -> str:
+    """Path join that preserves URI schemes ('gs://b/dir' + 'f')."""
+    scheme, rest = _split_scheme(base)
+    joined = "/".join([rest.rstrip("/")] + [p.strip("/") for p in parts])
+    return f"{scheme}://{joined}" if scheme else os.path.join(base, *parts)
+
+
+def atomic_write(path: str, data: bytes) -> None:
+    """Write-then-rename where supported; plain write on object stores
+    (their puts are already atomic per key)."""
+    fs, p = get_filesystem(path)
+    if isinstance(fs, LocalFileSystem):
+        tmp = p + ".tmp"
+        with fs.open(tmp, "wb") as f:
+            f.write(data)
+        fs.rename(tmp, p)
+    else:
+        with fs.open(p, "wb") as f:
+            f.write(data)
