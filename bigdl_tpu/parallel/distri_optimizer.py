@@ -51,6 +51,23 @@ from bigdl_tpu.parallel.parameters import AllReduceParameter
 log = logging.getLogger("bigdl_tpu.optim")
 
 
+def _fetch_to_host(x) -> np.ndarray:
+    """np.asarray that works for arrays sharded across processes: shards
+    on other hosts are not addressable here, so gather them first (the
+    reference's getModel pulls weight slices from all partitions the same
+    way, DistriOptimizer.scala:534-564)."""
+    if jax.process_count() > 1 and not x.is_fully_replicated:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
+def _fetch_tree_to_host(tree):
+    return jax.tree_util.tree_map(
+        lambda l: _fetch_to_host(l) if isinstance(l, jax.Array)
+        else np.asarray(l), tree)
+
+
 def _shard_batch(mesh: Mesh, array: np.ndarray):
     """Place a host batch as a global array sharded on dim 0 over 'data'.
     In a multi-host job each process passes its local shard and the global
@@ -190,10 +207,9 @@ class DistriOptimizer(Optimizer):
                 if published:
                     return
                 published = True
-                self.model.params = arp.to_pytree(np.asarray(w_shards))
+                self.model.params = arp.to_pytree(_fetch_to_host(w_shards))
                 self.model.buffers = buffers
-                self.optim_method._state = jax.tree_util.tree_map(
-                    np.asarray, opt_state)
+                self.optim_method._state = _fetch_tree_to_host(opt_state)
 
             ts = self.train_summary
             do_param_hist = (ts is not None and hasattr(ts, "should_record")
@@ -221,7 +237,7 @@ class DistriOptimizer(Optimizer):
                     self._checkpoint()
         self.state["records_processed"] = records_this_epoch
         log.info("training finished in %.1fs", time.perf_counter() - wall0)
-        self.model.params = arp.to_pytree(np.asarray(w_shards))
+        self.model.params = arp.to_pytree(_fetch_to_host(w_shards))
         self.model.buffers = buffers
         return self.model
 

@@ -125,8 +125,14 @@ def _encode_state(d: dict) -> dict:
     for k, v in d.items():
         if k in _SHELL_ATTRS or any(k.startswith(p) for p in _SHELL_PREFIXES):
             continue
-        if callable(v) and not isinstance(v, type):
-            continue  # bound jitted callables etc. are rebuilt lazily
+        from bigdl_tpu.nn.module import Criterion, Module
+        if (callable(v) and not isinstance(v, (type, Module, Criterion))):
+            if k.startswith("_"):
+                continue  # private machinery (caches etc.), rebuilt lazily
+            raise TypeError(
+                f"cannot serialize callable hyperparameter {k!r} "
+                f"({type(v).__name__}); persistence would silently drop "
+                f"it — hold a Module/class instead of a bare function")
         out[k] = _encode_value(v)
     return out
 
@@ -211,7 +217,7 @@ def load_module(path: str, template=None):
     module = template if template is not None else build_module(state["spec"])
     params = state["params"]
     if template is not None:
-        # structure check without materializing a throwaway random init
+        # structure + shape check without materializing a throwaway init
         ref = jax.eval_shape(module.init, jax.random.PRNGKey(0))
         want = jax.tree_util.tree_structure(ref)
         got = jax.tree_util.tree_structure(params)
@@ -219,6 +225,15 @@ def load_module(path: str, template=None):
             raise ValueError(
                 f"checkpoint param tree does not match template: "
                 f"{got} vs {want}")
+        for (path, r), l in zip(
+                jax.tree_util.tree_flatten_with_path(ref)[0],
+                jax.tree_util.tree_leaves(params)):
+            if tuple(r.shape) != tuple(np.shape(l)):
+                name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                                for p in path)
+                raise ValueError(
+                    f"checkpoint param {name} has shape {np.shape(l)}, "
+                    f"template expects {tuple(r.shape)}")
     module.params = params
     module.buffers = state["buffers"]
     if module.grad_params is None:

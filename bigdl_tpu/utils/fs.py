@@ -194,15 +194,21 @@ def join(base: str, *parts: str) -> str:
     return f"{scheme}://{joined}" if scheme else os.path.join(base, *parts)
 
 
+# object stores where a single put is already atomic per key — a tmp +
+# rename there costs an extra copy for no safety
+_ATOMIC_PUT_SCHEMES = {"gs", "gcs", "s3", "s3a", "az", "abfs"}
+
+
 def atomic_write(path: str, data: bytes) -> None:
-    """Write-then-rename where supported; plain write on object stores
-    (their puts are already atomic per key)."""
+    """Write-then-rename by default (a killed writer must never leave a
+    truncated file at the final path — e.g. hdfs:// writes are not
+    atomic); plain write only on object stores with atomic puts."""
     fs, p = get_filesystem(path)
-    if isinstance(fs, LocalFileSystem):
-        tmp = p + ".tmp"
-        with fs.open(tmp, "wb") as f:
-            f.write(data)
-        fs.rename(tmp, p)
-    else:
+    if isinstance(fs, FsspecFileSystem) and fs._scheme in _ATOMIC_PUT_SCHEMES:
         with fs.open(p, "wb") as f:
             f.write(data)
+        return
+    tmp = p + ".tmp"
+    with fs.open(tmp, "wb") as f:
+        f.write(data)
+    fs.rename(tmp, p)

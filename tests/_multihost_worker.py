@@ -1,0 +1,60 @@
+"""Worker for the simulated multi-host test (run as a subprocess).
+
+usage: python tests/_multihost_worker.py <process_id> <num_processes> <port>
+
+Each process owns 2 virtual CPU devices and its round-robin shard of the
+global dataset; the DistriOptimizer step assembles global batches with
+``jax.make_array_from_process_local_data`` — the multi-host branch that
+has no coverage inside single-process pytest.  Prints one JSON line with
+the per-iteration losses (identical on every process: the loss is
+pmean'd across the mesh).
+"""
+import json
+import os
+import sys
+
+
+def main():
+    proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nproc, process_id=proc_id)
+    assert jax.process_count() == nproc
+    assert jax.local_device_count() == 2
+
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset.dataset import DistributedDataSet
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.dataset.types import Sample
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.parallel import DistriOptimizer
+
+    rng = np.random.RandomState(0)  # same records in every process
+    records = [Sample(rng.randn(4).astype(np.float32),
+                      np.asarray(float(i % 2) + 1, np.float32))
+               for i in range(16)]
+    ds = DistributedDataSet(records)
+    ds.shuffle = lambda: None  # deterministic order for the parity check
+    local_batch = 8 // nproc
+    pipeline = ds >> SampleToBatch(local_batch, drop_last=True)
+
+    model = nn.Sequential(nn.Linear(4, 4), nn.Tanh(),
+                          nn.Linear(4, 2), nn.LogSoftMax())
+    opt = DistriOptimizer(model, pipeline, nn.ClassNLLCriterion())
+    opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)) \
+       .set_end_when(Trigger.max_iteration(3))
+
+    opt.optimize()
+    print(json.dumps({"process": proc_id,
+                      "final_loss": float(opt.state["loss"]),
+                      "global_devices": jax.device_count()}))
+    jax.distributed.shutdown()
+
+
+if __name__ == "__main__":
+    main()
