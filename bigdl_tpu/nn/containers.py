@@ -60,6 +60,8 @@ class Container(Module):
         p = params.get(str(i), {}) if params else {}
         b_in = buffers.get(str(i), {}) if buffers else {}
         r = fold_rng(rng, i)
+        if Module._probe is not None:
+            Module._probe(self, i, self.modules[i], x, p, b_in)
         if getattr(self, "remat", False):
             # rematerialize child activations in the backward pass
             # (jax.checkpoint: trades FLOPs for HBM — the TPU-idiomatic
@@ -181,6 +183,8 @@ class MapTable(Container):
         out = T()
         b = buffers.get("0", {})
         for i, xi in enumerate(xs):
+            if Module._probe is not None:
+                Module._probe(self, 0, self.modules[0], xi, params["0"], b)
             y, b = self.modules[0].apply(params["0"], xi, buffers=b,
                                          training=training, rng=fold_rng(rng, i))
             out.insert(y)

@@ -45,6 +45,14 @@ class Module:
     """Base module (ref AbstractModule).  Subclasses implement ``init`` and
     either ``f`` (stateless: params, x -> y) or ``apply`` (stateful)."""
 
+    # set by utils.profiling during a shape-recording pass: called as
+    # probe(parent, child_index, child, child_input, child_params,
+    # child_buffers) from every container dispatch, so per-layer cost
+    # attribution sees each layer's actual inputs AND its params slice
+    # (nested containers' OO-shell .params is None; only the dispatched
+    # slice is real)
+    _probe = None
+
     def __init__(self):
         self._name: Optional[str] = None
         # OO shell state (not used by the functional path)

@@ -285,6 +285,7 @@ class LocalOptimizer(Optimizer):
             self._maybe_checkpoint()
         self.state["records_processed"] = records_this_epoch
         log.info("training finished in %.1fs", time.perf_counter() - wall0)
+        log.info("phase breakdown: %s", self.metrics.summary())
         self.model.params, self.model.buffers = params, buffers
         return self.model
 

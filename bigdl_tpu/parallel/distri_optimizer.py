@@ -94,6 +94,10 @@ class DistriOptimizer(Optimizer):
         super().__init__(model, dataset, criterion)
         self.mesh = mesh if mesh is not None else data_parallel_mesh()
         self.n_slots = int(np.prod(self.mesh.devices.shape))
+        # kept for on-demand collective_footprint()
+        self._step_fn_ref = None
+        self._step_avals = None
+        self._footprint = None
 
     # ------------------------------------------------------------------ #
     def _build_step(self, arp: AllReduceParameter):
@@ -142,6 +146,26 @@ class DistriOptimizer(Optimizer):
     # ------------------------------------------------------------------ #
     def optimize(self) -> Module:
         self._init_driver_state()
+        if jax.process_count() > 1:
+            # publish() runs a cross-process gather, and the triggers that
+            # fire it are evaluated per-process: asymmetric configuration
+            # would leave some hosts inside a collective the others never
+            # enter (silent deadlock).  Verify symmetry once, loudly.
+            from jax.experimental import multihost_utils
+            cfg = np.array(
+                [self.train_summary is not None,
+                 self.validation_trigger is not None
+                 and self.validation_dataset is not None,
+                 self.checkpoint_trigger is not None
+                 and self.checkpoint_path is not None], np.int32)
+            ref = multihost_utils.broadcast_one_to_all(cfg)
+            if not np.array_equal(cfg, ref):
+                raise ValueError(
+                    "summary/validation/checkpoint configuration differs "
+                    "across processes (this host: "
+                    f"{cfg.tolist()}, process 0: {ref.tolist()}); "
+                    "asymmetric triggers deadlock the publish collective — "
+                    "configure every process identically")
         self.model._built()
         arp = AllReduceParameter(self.model.params, self.n_slots)
         w_shards = jnp.reshape(arp.init_shards(self.model.params), (-1,))
@@ -167,9 +191,28 @@ class DistriOptimizer(Optimizer):
             self.state["epoch_finished"] = False
             batch = next(data_iter)
             local_bs = batch.data.shape[0]
+            t_shard = time.perf_counter()
             data = _shard_batch(self.mesh, np.asarray(batch.data))
             labels = _shard_batch(self.mesh, np.asarray(batch.labels))
+            # phase metric: host->device batch placement (the data-side
+            # analog of the reference's per-phase Metrics,
+            # optim/DistriOptimizer.scala:115-119)
+            self.metrics.add("shard data time", time.perf_counter() - t_shard)
             rng, sub = jax.random.split(rng)
+            if self._step_avals is None:
+                # shape/dtype/sharding snapshot so collective_footprint()
+                # can lower+compile on demand — no tracing cost here
+                def sds(a):
+                    a = jnp.asarray(a) if not isinstance(a, jax.Array) else a
+                    try:
+                        return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                    sharding=a.sharding)
+                    except Exception:
+                        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+                self._step_fn_ref = step_fn
+                self._step_avals = jax.tree_util.tree_map(
+                    sds, (w_shards, opt_state, buffers, data, labels, sub,
+                          jnp.asarray(self.state["epoch"])))
             t0 = time.perf_counter()
             w_shards, opt_state, buffers, loss = step_fn(
                 w_shards, opt_state, buffers, data, labels, sub,
@@ -207,9 +250,12 @@ class DistriOptimizer(Optimizer):
                 if published:
                     return
                 published = True
+                t_pub = time.perf_counter()
                 self.model.params = arp.to_pytree(_fetch_to_host(w_shards))
                 self.model.buffers = buffers
                 self.optim_method._state = _fetch_tree_to_host(opt_state)
+                self.metrics.add("publish time",
+                                 time.perf_counter() - t_pub)
 
             ts = self.train_summary
             do_param_hist = (ts is not None and hasattr(ts, "should_record")
@@ -237,9 +283,28 @@ class DistriOptimizer(Optimizer):
                     self._checkpoint()
         self.state["records_processed"] = records_this_epoch
         log.info("training finished in %.1fs", time.perf_counter() - wall0)
+        log.info("phase breakdown: %s", self.metrics.summary())
         self.model.params = arp.to_pytree(_fetch_to_host(w_shards))
         self.model.buffers = buffers
         return self.model
+
+    def collective_footprint(self) -> dict:
+        """Bytes per step moved by each collective in the compiled training
+        step — the fused-program analog of the reference's "get weights
+        average" (all-gather row) and "aggregate gradient time"
+        (reduce-scatter row) Metrics (optim/DistriOptimizer.scala:115-213).
+        Requires ``optimize()`` to have run at least one iteration.  The
+        first call pays one lower+compile of the step; the parsed result is
+        cached."""
+        if self._footprint is not None:
+            return self._footprint
+        if self._step_avals is None:
+            raise RuntimeError("run optimize() first — the footprint is "
+                               "read from the compiled training step")
+        from bigdl_tpu.utils import profiling
+        compiled = self._step_fn_ref.lower(*self._step_avals).compile()
+        self._footprint = profiling.collective_footprint(compiled.as_text())
+        return self._footprint
 
     def _validate(self):
         if getattr(self, "_validator", None) is None:

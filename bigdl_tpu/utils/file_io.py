@@ -225,12 +225,12 @@ def load_module(path: str, template=None):
             raise ValueError(
                 f"checkpoint param tree does not match template: "
                 f"{got} vs {want}")
-        for (path, r), l in zip(
+        for (kp, r), l in zip(
                 jax.tree_util.tree_flatten_with_path(ref)[0],
                 jax.tree_util.tree_leaves(params)):
             if tuple(r.shape) != tuple(np.shape(l)):
                 name = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
-                                for p in path)
+                                for p in kp)
                 raise ValueError(
                     f"checkpoint param {name} has shape {np.shape(l)}, "
                     f"template expects {tuple(r.shape)}")

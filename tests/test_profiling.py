@@ -1,0 +1,125 @@
+"""Per-layer cost attribution (VERDICT r1 missing #3 / next #5): the
+reference's per-module forwardTime/backwardTime hooks reborn as compiled
+XLA cost analysis scaled by measured jitted-step wall time, plus the
+Metrics phase breakdown and a collective footprint of the fused step."""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import ResNet
+from bigdl_tpu.utils import profiling
+
+
+def _small_model():
+    return nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1),
+        nn.ReLU(True),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape((8 * 8 * 8,)),
+        nn.Linear(8 * 8 * 8, 10),
+        nn.LogSoftMax(),
+    ).build(seed=0)
+
+
+def test_profile_layers_reports_compiled_flops(nprng):
+    m = _small_model()
+    x = jnp.asarray(nprng.randn(4, 3, 16, 16).astype(np.float32))
+    rows = profiling.profile_layers(m, x, training=True)
+    by_name = {r["name"]: r for r in rows}
+    # conv and linear dominate; XLA's own numbers, so just sanity-check
+    # ordering and positivity
+    assert by_name["SpatialConvolution"]["flops_fwd"] > 0
+    assert by_name["Linear"]["flops_fwd"] > 0
+    assert (by_name["SpatialConvolution"]["flops_train"]
+            >= by_name["SpatialConvolution"]["flops_fwd"])
+    # execution order preserved, leaves only (no Sequential row)
+    assert [r["name"] for r in rows][0] == "SpatialConvolution"
+    assert all(r["name"] != "Sequential" for r in rows)
+
+
+def test_attribute_step_time_fills_get_times_from_jitted_run(nprng):
+    """The VERDICT 'done' check: non-zero per-layer times from a jitted
+    training run, surfaced through the reference get_times() API."""
+    m = _small_model()
+    x = jnp.asarray(nprng.randn(4, 3, 16, 16).astype(np.float32))
+    y = jnp.asarray((nprng.randint(0, 10, 4) + 1).astype(np.float32))
+    crit = nn.ClassNLLCriterion()
+
+    @jax.jit
+    def step(p, xx, yy):
+        def loss(pp):
+            out, _ = m.apply(pp, xx, buffers=m.buffers, training=True,
+                             rng=jax.random.PRNGKey(0))
+            return crit.loss(out, yy)
+        return jax.value_and_grad(loss)(p)
+
+    step(m.params, x, y)  # compile
+    t0 = time.perf_counter()
+    loss, _ = step(m.params, x, y)
+    float(loss)
+    step_time = time.perf_counter() - t0
+
+    m.reset_times()
+    rows = profiling.attribute_step_time(m, x, step_time, training=True)
+    assert abs(sum(r["time_s"] for r in rows) - step_time) < 1e-9
+    times = m.get_times()
+    per_layer = {mod.get_name(): f + b for mod, f, b in times
+                 if not getattr(mod, "modules", None)}
+    assert per_layer["SpatialConvolution"] > 0
+    assert per_layer["Linear"] > 0
+    # conv does more work than the tail linear here
+    assert per_layer["SpatialConvolution"] > per_layer["LogSoftMax"]
+
+
+def test_attribution_walks_nested_containers(nprng):
+    m = ResNet(class_num=10, depth=8, dataset="cifar10").build(seed=1)
+    x = jnp.asarray(nprng.randn(2, 3, 32, 32).astype(np.float32))
+    rows = profiling.profile_layers(m, x, training=False)
+    names = [r["name"] for r in rows]
+    assert names.count("SpatialConvolution") >= 7  # stem + blocks + shortcuts
+    assert "SpatialBatchNormalization" in names
+    # every nested conv must carry real compiled flops (regression: the
+    # dispatched params slice, not the parent shell's .params, feeds the
+    # probe — nested containers' shell params are None)
+    convs = [r for r in rows if r["name"] == "SpatialConvolution"]
+    assert all(r["flops_fwd"] > 0 for r in convs), \
+        [(r["name"], r["flops_fwd"]) for r in rows]
+    linears = [r for r in rows if r["name"] == "Linear"]
+    assert linears and all(r["flops_fwd"] > 0 for r in linears)
+
+
+def test_distri_phase_metrics_and_collective_footprint(nprng):
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+    from bigdl_tpu.parallel.mesh import DATA_AXIS
+
+    samples = [Sample(nprng.randn(4).astype(np.float32),
+                      np.asarray(float(i % 2) + 1, np.float32))
+               for i in range(16)]
+    ds = DataSet.array(samples) >> SampleToBatch(8, drop_last=True)
+    mesh = create_mesh({DATA_AXIS: 4}, devices=jax.devices()[:4])
+    m = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2),
+                      nn.LogSoftMax())
+    opt = DistriOptimizer(m, ds, nn.ClassNLLCriterion(), mesh=mesh)
+    opt.set_optim_method(SGD(learning_rate=0.1)) \
+       .set_end_when(Trigger.max_iteration(2))
+    opt.optimize()
+    summary = opt.metrics.summary()
+    assert "shard data time" in summary and "computing time" in summary
+    fp = opt.collective_footprint()
+    # the ZeRO-1 cycle = bf16 all-gather of weights + reduce-scatter (or
+    # all-reduce, depending on how XLA lowers psum_scatter) of gradients
+    assert fp, f"no collectives found: {fp}"
+    assert any(k in fp for k in ("all-gather", "reduce-scatter",
+                                 "all-reduce")), fp
+
+
+def test_shape_bytes_parser():
+    assert profiling._shape_bytes("f32[128,1024]{1,0}") == 128 * 1024 * 4
+    assert profiling._shape_bytes("bf16[8]") == 16
+    assert profiling._shape_bytes("(f32[4], bf16[4])") == 16 + 8
