@@ -41,6 +41,12 @@ _DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
                 "f64": 8, "c64": 8, "c128": 16}
 
 
+def _dtype_bytes(name: str) -> int:
+    if name.startswith("f8") or name.startswith("s4") or name.startswith("u4"):
+        return 1
+    return _DTYPE_BYTES.get(name, 4)
+
+
 def record_layer_inputs(model: Module, x, training: bool = False,
                         rng=None) -> list:
     """Run one eager forward, returning [(parent, index, child, input,
@@ -70,7 +76,8 @@ def _flops_of_compiled(compiled) -> float:
     return float(cost.get("flops", 0.0) or 0.0)
 
 
-def _layer_flops(child: Module, params, buffers, inp, training: bool):
+def _layer_flops(child: Module, params, buffers, inp, training: bool,
+                 include_train: bool = True):
     """(forward flops, training flops) of one layer, per XLA."""
     rng = jax.random.PRNGKey(0)
 
@@ -80,6 +87,8 @@ def _layer_flops(child: Module, params, buffers, inp, training: bool):
 
     lowered = jax.jit(fwd).lower(params, inp)
     f_fwd = _flops_of_compiled(lowered.compile())
+    if not include_train:
+        return f_fwd, f_fwd
 
     def train(p, i):
         def scalar(pp):
@@ -98,17 +107,21 @@ def _layer_flops(child: Module, params, buffers, inp, training: bool):
     return f_fwd, f_train
 
 
-def profile_layers(model: Module, x, training: bool = True) -> list[dict]:
+def profile_layers(model: Module, x, training: bool = True,
+                   include_train: bool = True) -> list[dict]:
     """Per-LEAF-layer compiled flops for one forward and one training step.
     Returns [{'module', 'name', 'flops_fwd', 'flops_train'}] in execution
-    order."""
+    order.  ``include_train=False`` skips the value-and-grad compile
+    (flops_train then mirrors flops_fwd) — half the compile cost when the
+    caller only needs forward flops (e.g. pipeline stage balancing)."""
     records = record_layer_inputs(model, x, training=training)
     rows = []
     for parent, idx, child, inp, p, b in records:
         if getattr(child, "modules", None):
             continue  # containers: attributed via their leaves
         try:
-            f_fwd, f_train = _layer_flops(child, p, b, inp, training)
+            f_fwd, f_train = _layer_flops(child, p, b, inp, training,
+                                          include_train=include_train)
         except Exception:
             f_fwd = f_train = 0.0  # shape-only layers XLA folds away
         rows.append({"module": child, "name": child.get_name(),
@@ -142,13 +155,13 @@ def _shape_bytes(shape_str: str) -> int:
     """bytes of an HLO shape literal like 'f32[128,1024]{1,0}' or a tuple
     '(f32[8], f32[8])'."""
     total = 0
-    for m in re.finditer(r"([a-z]+\d*)\[([\d,]*)\]", shape_str):
+    for m in re.finditer(r"\b([a-z][a-z0-9]*)\[([\d,]*)\]", shape_str):
         dtype, dims = m.group(1), m.group(2)
         n = 1
         if dims:
             for d in dims.split(","):
                 n *= int(d)
-        total += n * _DTYPE_BYTES.get(dtype, 4)
+        total += n * _dtype_bytes(dtype)
     return total
 
 
@@ -171,10 +184,11 @@ def collective_footprint(compiled_text: str) -> dict[str, int]:
         if phase == "-done":
             continue  # the async pair's bytes are counted on -start
         if phase == "-start":
-            # async start shapes are (operand..., result...) tuples; the
-            # result is the last element
-            shapes = re.findall(r"[a-z]+\d*\[[\d,]*\](?:\{[\d,]*\})?", shape)
+            # async start shapes are (operand..., result...) tuples with
+            # one result per operand; count the result half
+            shapes = re.findall(r"[a-z][a-z0-9]*\[[\d,]*\](?:\{[\d,]*\})?",
+                                shape)
             if shapes:
-                shape = shapes[-1]
+                shape = " ".join(shapes[len(shapes) // 2:])
         out[op] += _shape_bytes(shape)
     return {k: v for k, v in out.items() if v}
