@@ -3,14 +3,23 @@
 
 Capability extension beyond the reference (SURVEY.md §5.8; its closest
 ancestor is ``MixtureTable``, which mixes full expert outputs on one
-node).  TPU-first design: dense one-hot dispatch (static shapes — no
-gather/scatter of ragged token sets) with each device computing only its
-local expert slice; a single ``psum`` over the expert axis combines the
-weighted outputs.  Top-1 (switch) routing with a load-balancing auxiliary
-loss.
+node).  TPU-first design, top-1 (switch) routing with a load-balancing
+auxiliary loss, two dispatch modes:
+
+- ``capacity_factor=None`` — dense dispatch: every expert sees every
+  token, masked.  Exact (no token drops) but expert compute scales with
+  n_experts x tokens; kept as the correctness oracle and for tiny T.
+- ``capacity_factor=c`` — Switch/GShard capacity dispatch: each expert
+  processes at most ``C = ceil(c * T / n_experts)`` tokens via a static
+  (T, E, C) one-hot dispatch tensor (einsum dispatch keeps shapes static
+  — no ragged gather/scatter), tokens over capacity are dropped (their
+  output is zero, the standard Switch behavior).  Per-token expert-FFN
+  FLOPs are then independent of the expert count — the scaling story
+  expert parallelism exists for.
 """
 from __future__ import annotations
 
+import math
 from functools import partial
 from typing import Optional
 
@@ -42,7 +51,8 @@ def init_moe_params(rng, n_experts: int, d_model: int, d_hidden: int):
 
 
 def moe_apply_local(params, x, *, axis: str = EXPERT_AXIS,
-                    data_axis: Optional[str] = None):
+                    data_axis: Optional[str] = None,
+                    capacity_factor: Optional[float] = None):
     """Per-device body (inside shard_map over ``axis``).  ``params['w1'/
     'w2']`` hold the LOCAL expert slice (E_local, ...); ``x`` (T, D) is
     replicated over the axis.  Returns (y (T, D), aux_loss)."""
@@ -55,15 +65,35 @@ def moe_apply_local(params, x, *, axis: str = EXPERT_AXIS,
     top = jnp.argmax(probs, axis=-1)                    # (T,) top-1 routing
     onehot = jax.nn.one_hot(top, n_total, dtype=x.dtype)
     gate_val = jnp.sum(probs * onehot, axis=-1)         # (T,)
-
-    # dense dispatch to the local slice only
     lo = my_idx * e_local
-    local_mask = lax.dynamic_slice_in_dim(onehot, lo, e_local, axis=1)
-    dispatched = jnp.einsum("te,td->etd", local_mask, x)     # (E_l, T, D)
-    h = jax.nn.relu(jnp.einsum("etd,edh->eth", dispatched, params["w1"]))
-    out = jnp.einsum("eth,ehd->etd", h, params["w2"])        # (E_l, T, D)
-    y_local = jnp.einsum("etd,te->td", out, local_mask)
-    y = lax.psum(y_local, axis) * gate_val[:, None]
+
+    if capacity_factor is None:
+        # dense dispatch to the local slice only (exact; oracle path)
+        local_mask = lax.dynamic_slice_in_dim(onehot, lo, e_local, axis=1)
+        dispatched = jnp.einsum("te,td->etd", local_mask, x)  # (E_l, T, D)
+        h = jax.nn.relu(jnp.einsum("etd,edh->eth", dispatched, params["w1"]))
+        out = jnp.einsum("eth,ehd->etd", h, params["w2"])     # (E_l, T, D)
+        y_local = jnp.einsum("etd,te->td", out, local_mask)
+        y = lax.psum(y_local, axis) * gate_val[:, None]
+    else:
+        # Switch capacity dispatch: expert e takes its first C routed
+        # tokens; the (T, E, C) one-hot keeps every shape static
+        t_tokens = x.shape[0]
+        cap = max(1, int(math.ceil(capacity_factor * t_tokens / n_total)))
+        # 0-based position of each token within its expert's queue — in
+        # integer arithmetic: a bf16 cumsum stops counting exactly at 256
+        # and would silently collide capacity slots
+        oh_i = onehot.astype(jnp.int32)
+        pos = jnp.sum(jnp.cumsum(oh_i, axis=0) * oh_i, axis=-1) - 1
+        keep = (pos < cap).astype(x.dtype)
+        pos_oh = jax.nn.one_hot(pos, cap, dtype=x.dtype) * keep[:, None]
+        local_mask = lax.dynamic_slice_in_dim(onehot, lo, e_local, axis=1)
+        dispatch = local_mask[:, :, None] * pos_oh[:, None, :]  # (T,E_l,C)
+        expert_in = jnp.einsum("td,tec->ecd", x, dispatch)      # (E_l,C,D)
+        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, params["w1"]))
+        out = jnp.einsum("ech,ehd->ecd", h, params["w2"])       # (E_l,C,D)
+        combine = dispatch * gate_val[:, None, None]
+        y = lax.psum(jnp.einsum("ecd,tec->td", out, combine), axis)
 
     # switch-transformer load-balancing loss: n_total * sum_e f_e * p_e
     frac = jnp.mean(onehot, axis=0)
@@ -79,17 +109,21 @@ def moe_apply_local(params, x, *, axis: str = EXPERT_AXIS,
 
 
 def moe_apply(params, x, mesh: Mesh, *, axis: str = EXPERT_AXIS,
-              data_axis: Optional[str] = None):
+              data_axis: Optional[str] = None,
+              capacity_factor: Optional[float] = None):
     """Global-view MoE over tokens ``x`` (T, D) (or (B, T, D) — flattened
     internally).  Experts shard over ``axis``; pass ``data_axis`` to keep
-    the token batch sharded over it on a 2-D mesh.  Returns (y, aux)."""
+    the token batch sharded over it on a 2-D mesh.  ``capacity_factor``
+    switches to capacity-bounded dispatch (see module docstring); the
+    capacity applies per token shard.  Returns (y, aux)."""
     shape = x.shape
     if x.ndim == 3:
         x = x.reshape(-1, shape[-1])
     xspec = P(data_axis, None) if data_axis else P(None, None)
     pspec = {"gate": P(None, None), "w1": P(axis, None, None),
              "w2": P(axis, None, None)}
-    fn = shard_map(partial(moe_apply_local, axis=axis, data_axis=data_axis),
+    fn = shard_map(partial(moe_apply_local, axis=axis, data_axis=data_axis,
+                           capacity_factor=capacity_factor),
                    mesh=mesh, in_specs=(pspec, xspec),
                    out_specs=(xspec, P()))
     y, aux = fn(params, x)
