@@ -1,0 +1,44 @@
+"""Autoencoder MNIST evaluation CLI (reference-parity Test main: load a
+trained model and report reconstruction loss on the test set; the
+reference ships Train+Test mains per model family).
+
+    python -m bigdl_tpu.models.autoencoder.test --model model.ckpt -f ./
+    python -m bigdl_tpu.models.autoencoder.test --model model.ckpt --synthetic
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+from bigdl_tpu.models.autoencoder.train import _to_autoencoder_batch
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Evaluate Autoencoder on MNIST")
+    p.add_argument("--model", required=True, help="trained model file")
+    p.add_argument("-f", "--folder", default="./")
+    p.add_argument("-b", "--batchSize", type=int, default=150)
+    p.add_argument("--synthetic", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, image, mnist
+    from bigdl_tpu.optim import LocalValidator, Loss
+
+    Engine.init()
+    records = mnist.synthetic(512, seed=9) if args.synthetic else \
+        mnist.load(args.folder, train=False)
+    ds = DataSet.array(records) >> (
+        image.BytesToGreyImg(28, 28)
+        >> image.GreyImgNormalizer(0.0, 255.0)
+        >> image.GreyImgToBatch(args.batchSize)) >> _to_autoencoder_batch()
+
+    model = nn.Module.load(args.model)
+    for method, result in LocalValidator(model, ds).test(
+            [Loss(nn.MSECriterion())]):
+        print(f"{method} is {result}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""SimpleRNN language-model evaluation CLI (ref models/rnn/Test.scala:
+load a trained model and report per-timestep loss on held-out text).
+
+    python -m bigdl_tpu.models.rnn.test --model model.ckpt -f input.txt
+    python -m bigdl_tpu.models.rnn.test --model model.ckpt --synthetic
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+from bigdl_tpu.models.rnn.train import _SYNTH
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Evaluate SimpleRNN LM")
+    p.add_argument("--model", required=True, help="trained model file")
+    p.add_argument("-f", "--folder", default=None, help="input text file")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("--vocabSize", type=int, default=4000)
+    p.add_argument("--seqLength", type=int, default=24)
+    p.add_argument("--synthetic", action="store_true")
+    args = p.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet, text
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import LocalValidator, Loss
+
+    Engine.init()
+    if args.synthetic or not args.folder:
+        raw = _SYNTH
+    else:
+        with open(args.folder) as f:
+            raw = f.read()
+
+    tokenize = text.SentenceSplitter() >> text.SentenceTokenizer() \
+        >> text.SentenceBiPadding()
+    token_lists = list(tokenize([raw]))
+    dictionary = text.Dictionary(token_lists, vocab_size=args.vocabSize)
+    vocab = dictionary.vocab_size()
+    pad_label = dictionary.get_index(text.SENTENCE_END) + 1
+    ds = DataSet.array(token_lists) >> (
+        text.TextToLabeledSentence(dictionary)
+        >> text.LabeledSentenceToSample(vocab, fixed_length=args.seqLength,
+                                        pad_label=pad_label)
+        >> SampleToBatch(args.batchSize))
+
+    model = nn.Module.load(args.model)
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    for method, result in LocalValidator(model, ds).test([Loss(criterion)]):
+        print(f"{method} is {result}")
+
+
+if __name__ == "__main__":
+    main()

@@ -66,10 +66,10 @@ def moe_apply_local(params, x, *, axis: str = EXPERT_AXIS,
     onehot = jax.nn.one_hot(top, n_total, dtype=x.dtype)
     gate_val = jnp.sum(probs * onehot, axis=-1)         # (T,)
     lo = my_idx * e_local
+    local_mask = lax.dynamic_slice_in_dim(onehot, lo, e_local, axis=1)
 
     if capacity_factor is None:
         # dense dispatch to the local slice only (exact; oracle path)
-        local_mask = lax.dynamic_slice_in_dim(onehot, lo, e_local, axis=1)
         dispatched = jnp.einsum("te,td->etd", local_mask, x)  # (E_l, T, D)
         h = jax.nn.relu(jnp.einsum("etd,edh->eth", dispatched, params["w1"]))
         out = jnp.einsum("eth,ehd->etd", h, params["w2"])     # (E_l, T, D)
@@ -85,9 +85,9 @@ def moe_apply_local(params, x, *, axis: str = EXPERT_AXIS,
         # and would silently collide capacity slots
         oh_i = onehot.astype(jnp.int32)
         pos = jnp.sum(jnp.cumsum(oh_i, axis=0) * oh_i, axis=-1) - 1
-        keep = (pos < cap).astype(x.dtype)
-        pos_oh = jax.nn.one_hot(pos, cap, dtype=x.dtype) * keep[:, None]
-        local_mask = lax.dynamic_slice_in_dim(onehot, lo, e_local, axis=1)
+        # over-capacity tokens drop out here: one_hot of pos >= cap is a
+        # zero row, so they reach no capacity slot
+        pos_oh = jax.nn.one_hot(pos, cap, dtype=x.dtype)
         dispatch = local_mask[:, :, None] * pos_oh[:, None, :]  # (T,E_l,C)
         expert_in = jnp.einsum("td,tec->ecd", x, dispatch)      # (E_l,C,D)
         h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, params["w1"]))
