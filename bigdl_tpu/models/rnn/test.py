@@ -15,6 +15,10 @@ from bigdl_tpu.models.rnn.train import _SYNTH
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="Evaluate SimpleRNN LM")
     p.add_argument("--model", required=True, help="trained model file")
+    p.add_argument("--dictionary", default=None,
+                   help="dictionary.json saved by the train CLI; without "
+                        "it a dictionary is rebuilt from the input text, "
+                        "which only matches the model for the SAME corpus")
     p.add_argument("-f", "--folder", default=None, help="input text file")
     p.add_argument("-b", "--batchSize", type=int, default=32)
     p.add_argument("--vocabSize", type=int, default=4000)
@@ -38,7 +42,10 @@ def main(argv=None) -> None:
     tokenize = text.SentenceSplitter() >> text.SentenceTokenizer() \
         >> text.SentenceBiPadding()
     token_lists = list(tokenize([raw]))
-    dictionary = text.Dictionary(token_lists, vocab_size=args.vocabSize)
+    if args.dictionary:
+        dictionary = text.Dictionary.load(args.dictionary)
+    else:
+        dictionary = text.Dictionary(token_lists, vocab_size=args.vocabSize)
     vocab = dictionary.vocab_size()
     pad_label = dictionary.get_index(text.SENTENCE_END) + 1
     ds = DataSet.array(token_lists) >> (

@@ -52,6 +52,12 @@ def main(argv=None) -> None:
         >> text.SentenceBiPadding()
     token_lists = list(tokenize([raw]))
     dictionary = text.Dictionary(token_lists, vocab_size=args.vocabSize)
+    if args.checkpoint:
+        # the evaluation CLI must reuse THIS word->index mapping (the
+        # reference Train saves the dictionary next to the model)
+        import os
+        os.makedirs(args.checkpoint, exist_ok=True)
+        dictionary.save(os.path.join(args.checkpoint, "dictionary.json"))
     vocab = dictionary.vocab_size()
     pad_label = dictionary.get_index(text.SENTENCE_END) + 1
 

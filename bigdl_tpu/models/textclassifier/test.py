@@ -26,9 +26,8 @@ def main(argv=None) -> None:
     from bigdl_tpu import Engine, nn
     from bigdl_tpu.dataset import DataSet
     from bigdl_tpu.dataset.transformer import SampleToBatch
-    from bigdl_tpu.models.textclassifier.train import (_embed_docs,
-                                                       _synthetic_samples,
-                                                       load_glove)
+    from bigdl_tpu.models.textclassifier.train import (_synthetic_samples,
+                                                       load_news_samples)
     from bigdl_tpu.optim import LocalValidator, Top1Accuracy
 
     Engine.init()
@@ -37,36 +36,10 @@ def main(argv=None) -> None:
         samples = _synthetic_samples(256, class_num, args.seqLength,
                                      args.embedDim, seed=9)
     else:
-        import os
-
-        import numpy as np
-        from bigdl_tpu.dataset import text
-        news_dir = next((os.path.join(args.baseDir, d)
-                         for d in sorted(os.listdir(args.baseDir))
-                         if d.startswith("20news") or d.startswith("20_news")),
-                        None)
-        glove_path = os.path.join(args.baseDir, "glove.6B",
-                                  f"glove.6B.{args.embedDim}d.txt")
-        if news_dir is None or not os.path.exists(glove_path):
-            raise SystemExit(f"expected 20news dir and {glove_path} under "
-                             f"{args.baseDir}")
-        glove = load_glove(glove_path, args.embedDim)
-        tokenizer = text.SentenceTokenizer()
-        docs, labels = [], []
-        cats = [c for c in sorted(os.listdir(news_dir))
-                if os.path.isdir(os.path.join(news_dir, c))]
-        for li, cat in enumerate(cats, start=1):
-            cat_dir = os.path.join(news_dir, cat)
-            for fname in sorted(os.listdir(cat_dir)):
-                with open(os.path.join(cat_dir, fname), errors="ignore") as f:
-                    docs.append(tokenizer.transform_one(f.read()))
-                labels.append(float(li))
-        order = np.random.RandomState(42).permutation(len(docs))
-        docs = [docs[i] for i in order]
-        labels = [labels[i] for i in order]
-        samples = _embed_docs(docs, labels, glove, args.seqLength,
-                              args.embedDim)
-        samples = samples[int(len(samples) * 0.8):]  # the held-out split
+        # the shared loader guarantees this is the SAME held-out split the
+        # train CLI validated on (same shuffle seed, same 0.8 cut)
+        _, samples = load_news_samples(args.baseDir, args.seqLength,
+                                       args.embedDim)
 
     ds = DataSet.array(samples) >> SampleToBatch(args.batchSize)
     model = nn.Module.load(args.model)

@@ -55,6 +55,41 @@ def _embed_docs(docs, labels, glove, seq_len, embed_dim):
     return samples
 
 
+def load_news_samples(base_dir: str, seq_len: int, embed_dim: int):
+    """(train_samples, val_samples) from 20news + glove under base_dir.
+    One function shared by the train and test CLIs so the deterministic
+    shuffle and the 0.8 split point can never diverge (divergence would
+    silently leak training docs into evaluation)."""
+    from bigdl_tpu.dataset import text
+
+    news_dir = next((os.path.join(base_dir, d)
+                     for d in sorted(os.listdir(base_dir))
+                     if d.startswith("20news") or d.startswith("20_news")),
+                    None)
+    glove_path = os.path.join(base_dir, "glove.6B",
+                              f"glove.6B.{embed_dim}d.txt")
+    if news_dir is None or not os.path.exists(glove_path):
+        raise SystemExit(f"expected 20news dir and {glove_path} under "
+                         f"{base_dir}")
+    glove = load_glove(glove_path, embed_dim)
+    tokenizer = text.SentenceTokenizer()
+    docs, labels = [], []
+    cats = [c for c in sorted(os.listdir(news_dir))
+            if os.path.isdir(os.path.join(news_dir, c))]
+    for li, cat in enumerate(cats, start=1):
+        cat_dir = os.path.join(news_dir, cat)
+        for fname in sorted(os.listdir(cat_dir)):
+            with open(os.path.join(cat_dir, fname), errors="ignore") as f:
+                docs.append(tokenizer.transform_one(f.read()))
+            labels.append(float(li))
+    order = np.random.RandomState(42).permutation(len(docs))
+    docs = [docs[i] for i in order]
+    labels = [labels[i] for i in order]
+    samples = _embed_docs(docs, labels, glove, seq_len, embed_dim)
+    split = int(len(samples) * 0.8)
+    return samples[:split], samples[split:]
+
+
 def _synthetic_samples(n, class_num, seq_len, embed_dim, seed=0):
     from bigdl_tpu.dataset.types import Sample
 
@@ -85,30 +120,8 @@ def main(argv=None) -> None:
         val_samples = _synthetic_samples(256, class_num, args.seqLength, args.embedDim, seed=9)
     else:
         class_num = args.classNum
-        news_dir = next((os.path.join(args.baseDir, d)
-                         for d in sorted(os.listdir(args.baseDir))
-                         if d.startswith("20news") or d.startswith("20_news")), None)
-        glove_path = os.path.join(args.baseDir, "glove.6B",
-                                  f"glove.6B.{args.embedDim}d.txt")
-        if news_dir is None or not os.path.exists(glove_path):
-            raise SystemExit(f"expected 20news dir and {glove_path} under {args.baseDir}")
-        glove = load_glove(glove_path, args.embedDim)
-        tokenizer = text.SentenceTokenizer()
-        docs, labels = [], []
-        cats = [c for c in sorted(os.listdir(news_dir))
-                if os.path.isdir(os.path.join(news_dir, c))]
-        for li, cat in enumerate(cats, start=1):
-            cat_dir = os.path.join(news_dir, cat)
-            for fname in sorted(os.listdir(cat_dir)):
-                with open(os.path.join(cat_dir, fname), errors="ignore") as f:
-                    docs.append(tokenizer.transform_one(f.read()))
-                labels.append(float(li))
-        order = np.random.RandomState(42).permutation(len(docs))
-        docs = [docs[i] for i in order]
-        labels = [labels[i] for i in order]
-        samples = _embed_docs(docs, labels, glove, args.seqLength, args.embedDim)
-        split = int(len(samples) * 0.8)
-        train_samples, val_samples = samples[:split], samples[split:]
+        train_samples, val_samples = load_news_samples(
+            args.baseDir, args.seqLength, args.embedDim)
 
     batcher = SampleToBatch(args.batchSize)
     train_ds = DataSet.array(train_samples) >> batcher

@@ -89,6 +89,11 @@ def _load() -> ctypes.CDLL | None:
     dll.bt_shard_index.argtypes = [ctypes.c_char_p, ctypes.c_int64, i64p, i64p,
                                    ctypes.POINTER(ctypes.c_float),
                                    ctypes.c_int64, ctypes.c_int32]
+    dll.bt_hadoop_seq_index.restype = ctypes.c_int64
+    dll.bt_hadoop_seq_index.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                        i64p, i64p,
+                                        ctypes.POINTER(ctypes.c_float),
+                                        ctypes.c_int64]
     return dll
 
 
@@ -193,6 +198,36 @@ class _Lib:
             raise ValueError("record shard crc mismatch")
         if n == -3:  # cannot happen with the sizing above; defensive
             raise ValueError("record shard index overflow")
+        return offsets[:n], lengths[:n], labels[:n]
+
+    def hadoop_seq_index(self, buf):
+        """buf: bytes of a whole Text/Text SequenceFile.  Returns
+        (value offsets, value lengths, labels) numpy arrays; raises
+        ValueError on malformed input and NotImplementedError on
+        unsupported flavors (compression, non-Text classes, version < 6)
+        so callers can fall back to the python reader."""
+        import numpy as np
+        data = bytes(buf)
+        # a record is >= 10 bytes (reclen + keylen + 1-byte key + 1-byte
+        # value vints); the +1 keeps empty files from zero-size arrays
+        max_n = max((len(data)) // 10, 1)
+        offsets = np.empty(max_n, dtype=np.int64)
+        lengths = np.empty(max_n, dtype=np.int64)
+        labels = np.empty(max_n, dtype=np.float32)
+        n = self.dll.bt_hadoop_seq_index(
+            data, len(data),
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            max_n)
+        if n == -1:
+            raise ValueError("malformed SequenceFile")
+        if n == -3:
+            raise ValueError("SequenceFile index overflow")
+        if n == -4:
+            raise NotImplementedError("unsupported SequenceFile flavor")
+        if n == -5:
+            raise ValueError("SequenceFile key has a non-numeric label")
         return offsets[:n], lengths[:n], labels[:n]
 
 

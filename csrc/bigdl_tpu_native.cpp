@@ -238,4 +238,112 @@ int64_t bt_shard_index(const uint8_t* buf, int64_t len, int64_t* offsets,
     return n;
 }
 
+// --------------------------------------------------------------------- //
+// Hadoop SequenceFile indexer: one pass over an in-memory Text/Text     //
+// SequenceFile (the reference's ImageNet storage,                        //
+// image/BGRImgToLocalSeqFile.scala), emitting per-record value-payload  //
+// offsets/lengths and the label parsed from the key ("label" or         //
+// "name\nlabel").  Python fallback: dataset/hadoop_seqfile.py.          //
+// --------------------------------------------------------------------- //
+
+static int hseq_vint(const uint8_t* buf, int64_t len, int64_t* pos,
+                     int64_t* out) {
+    if (*pos >= len) return -1;
+    int8_t b = (int8_t)buf[(*pos)++];
+    if (b >= -112) { *out = b; return 0; }
+    bool neg = b < -120;
+    int n = neg ? -(b + 120) : -(b + 112);
+    if (*pos + n > len) return -1;
+    int64_t v = 0;
+    for (int i = 0; i < n; ++i) v = (v << 8) | buf[(*pos)++];
+    *out = neg ? ~v : v;
+    return 0;
+}
+
+static int32_t be32(const uint8_t* p) {
+    return (int32_t)(((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+                     ((uint32_t)p[2] << 8) | (uint32_t)p[3]);
+}
+
+// returns record count, or -1 malformed / -3 max_n reached /
+// -4 unsupported flavor (old version, non-Text classes, compression)
+int64_t bt_hadoop_seq_index(const uint8_t* buf, int64_t len,
+                            int64_t* offsets, int64_t* lengths,
+                            float* labels, int64_t max_n) {
+    static const char kText[] = "org.apache.hadoop.io.Text";
+    if (len < 4 || std::memcmp(buf, "SEQ", 3) != 0) return -1;
+    if (buf[3] < 6) return -4;
+    int64_t pos = 4;
+    for (int i = 0; i < 2; ++i) {
+        int64_t n;
+        if (hseq_vint(buf, len, &pos, &n) || n < 0 || pos + n > len) return -1;
+        if (n != (int64_t)(sizeof(kText) - 1) ||
+            std::memcmp(buf + pos, kText, n) != 0) return -4;
+        pos += n;
+    }
+    if (pos + 2 > len) return -1;
+    if (buf[pos] || buf[pos + 1]) return -4;  // (block-)compressed
+    pos += 2;
+    if (pos + 4 > len) return -1;
+    int32_t nmeta = be32(buf + pos);
+    pos += 4;
+    for (int64_t i = 0; i < 2 * (int64_t)nmeta; ++i) {
+        int64_t n;
+        if (hseq_vint(buf, len, &pos, &n) || n < 0 || pos + n > len) return -1;
+        pos += n;
+    }
+    if (pos + 16 > len) return -1;
+    const uint8_t* sync = buf + pos;
+    pos += 16;
+
+    int64_t cnt = 0;
+    while (pos < len) {
+        if (pos + 4 > len) return -1;
+        int32_t rec = be32(buf + pos);
+        pos += 4;
+        if (rec == -1) {  // sync escape
+            if (pos + 16 > len || std::memcmp(buf + pos, sync, 16) != 0)
+                return -1;
+            pos += 16;
+            continue;
+        }
+        if (cnt >= max_n) return -3;
+        if (rec < 0 || pos + 4 > len) return -1;
+        int32_t keylen = be32(buf + pos);
+        pos += 4;
+        if (keylen < 0 || keylen > rec || pos + rec > len) return -1;
+        // key = serialized Text; label is the number after the last '\n'
+        int64_t kp = pos, ktext;
+        if (hseq_vint(buf, len, &kp, &ktext) || ktext < 0 ||
+            kp + ktext > pos + keylen) return -1;
+        // label = the second '\n'-separated segment when a name is
+        // present, else the whole key (readLabel takes dataArr(1),
+        // DataSet.scala:397-405 — the python reader does the same)
+        const uint8_t* k = buf + kp;
+        int64_t lb = 0, le = ktext;
+        for (int64_t i = 0; i < ktext; ++i)
+            if (k[i] == '\n') { lb = i + 1; break; }
+        for (int64_t i = lb; i < ktext; ++i)
+            if (k[i] == '\n') { le = i; break; }
+        char tmp[64];
+        int64_t ll = le - lb;
+        if (ll <= 0 || ll > 63) return -5;  // bad label segment
+        std::memcpy(tmp, k + lb, ll);
+        tmp[ll] = 0;
+        char* end = nullptr;
+        labels[cnt] = std::strtof(tmp, &end);
+        if (end != tmp + ll) return -5;  // non-numeric label: match the
+        // python reader's ValueError rather than silently yielding 0.0
+        // value = serialized Text right after the key bytes
+        int64_t vp = pos + keylen, vtext;
+        if (hseq_vint(buf, len, &vp, &vtext) || vtext < 0 ||
+            vp + vtext > pos + rec) return -1;
+        offsets[cnt] = vp;
+        lengths[cnt] = vtext;
+        pos += rec;
+        ++cnt;
+    }
+    return cnt;
+}
+
 }  // extern "C"

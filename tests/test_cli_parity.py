@@ -22,7 +22,10 @@ class TestModelTestClis:
         ckpts = sorted(model_dir.glob("model.*"),
                        key=lambda p: int(p.name.split(".")[-1]))
         assert ckpts, "train CLI must write a checkpoint"
+        dict_path = model_dir / "dictionary.json"
+        assert dict_path.exists(), "train CLI must save the dictionary"
         rnn_test.main(["--model", str(ckpts[-1]), "--synthetic",
+                       "--dictionary", str(dict_path),
                        "-b", "8", "--seqLength", "8"])
         assert "Loss" in capsys.readouterr().out
 
@@ -177,3 +180,67 @@ class TestHadoopSeqFile:
                 seen.append((label, decode_bgr_value(value)))
         assert [s[0] for s in seen] == [1.0, 2.0, 3.0, 4.0, 5.0]
         np.testing.assert_array_equal(seen[0][1], imgs[0].data)
+
+
+class TestNativeHadoopIndexer:
+    def test_native_matches_python_reader(self, tmp_path):
+        from bigdl_tpu import native
+        from bigdl_tpu.dataset.hadoop_seqfile import (parse_key,
+                                                      read_sequence_file,
+                                                      write_sequence_file)
+
+        lib = native.get()
+        if lib is None:
+            pytest.skip("native library unavailable")
+        records = [(b"3", b"abc"), (b"name.JPEG\n7", b"0123456789" * 50),
+                   (b"1", b""), (b"2", bytes(range(100)))]
+        p = str(tmp_path / "n_0.seq")
+        write_sequence_file(p, records, sync_interval=2)
+        buf = open(p, "rb").read()
+        offsets, lengths, labels = lib.hadoop_seq_index(buf)
+        got = [(buf[o:o + n], float(l))
+               for o, n, l in zip(offsets, lengths, labels)]
+        want = [(v, parse_key(k)[1]) for k, v in read_sequence_file(p)]
+        assert got == want
+        assert [l for _, l in got] == [3.0, 7.0, 1.0, 2.0]
+
+    def test_native_rejects_malformed(self):
+        from bigdl_tpu import native
+
+        lib = native.get()
+        if lib is None:
+            pytest.skip("native library unavailable")
+        with pytest.raises(ValueError):
+            lib.hadoop_seq_index(b"NOTASEQFILE")
+        with pytest.raises(NotImplementedError):
+            # version 5 header flavor
+            lib.hadoop_seq_index(b"SEQ\x05" + b"\x00" * 64)
+
+    def test_native_rejects_non_numeric_label(self, tmp_path):
+        from bigdl_tpu import native
+        from bigdl_tpu.dataset.hadoop_seqfile import write_sequence_file
+
+        lib = native.get()
+        if lib is None:
+            pytest.skip("native library unavailable")
+        p = str(tmp_path / "bad_0.seq")
+        write_sequence_file(p, [(b"not-a-number", b"payload")])
+        with pytest.raises(ValueError, match="non-numeric label"):
+            lib.hadoop_seq_index(open(p, "rb").read())
+
+    def test_folder_records_uses_same_results_either_path(self, tmp_path,
+                                                          monkeypatch):
+        from bigdl_tpu.dataset import hadoop_seqfile as hs
+
+        records = [(str(i % 3 + 1).encode(), bytes([i]) * 8)
+                   for i in range(9)]
+        hs.write_sequence_file(str(tmp_path / "x_0.seq"), records)
+        fast = hs.SeqFileFolder.records(str(tmp_path))
+        monkeypatch.setenv("BIGDL_TPU_NO_NATIVE", "1")
+        # force the pure-python branch by nulling the native lib handle
+        import bigdl_tpu.native as native_mod
+        monkeypatch.setattr(native_mod.lib, "_dll", None)
+        monkeypatch.setattr(native_mod.lib, "_tried", True)
+        slow = hs.SeqFileFolder.records(str(tmp_path))
+        assert [(r.data, r.label) for r in fast] == \
+            [(r.data, r.label) for r in slow]
