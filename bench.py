@@ -24,6 +24,28 @@ import numpy as np
 
 
 def main() -> None:
+    import sys
+
+    env_batch = os.environ.get("BIGDL_TPU_BENCH_BATCH")
+    candidates = ([int(env_batch)] if env_batch else [256, 128])
+    last_err = None
+    for batch in candidates:
+        try:
+            _run(batch)
+            return
+        except Exception as e:
+            msg = str(e)
+            oom = ("RESOURCE_EXHAUSTED" in msg or "out of memory" in msg
+                   or "OOM" in msg)
+            if not oom:
+                raise  # real failure: surface the original traceback
+            last_err = e
+            print(f"bench: batch {batch} exhausted HBM; falling back",
+                  file=sys.stderr)
+    raise last_err
+
+
+def _run(batch: int) -> None:
     import jax
     import jax.numpy as jnp
     from bigdl_tpu import nn
@@ -31,7 +53,6 @@ def main() -> None:
     from bigdl_tpu.optim import SGD
 
     n_chips = jax.device_count()
-    batch = int(os.environ.get("BIGDL_TPU_BENCH_BATCH", "256"))
     model = ResNet(class_num=1000, depth=50, dataset="imagenet",
                    data_format="NHWC").build(seed=1)
     criterion = nn.ClassNLLCriterion()

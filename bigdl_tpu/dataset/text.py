@@ -84,14 +84,18 @@ class Dictionary:
         return self.index2word.get(index, self.UNK)
 
     def save(self, path: str) -> None:
-        with open(path, "w") as f:
-            json.dump({"word2index": self.word2index}, f)
+        """Persist word->index (fs layer: local, gs://, memory:// paths
+        all work — the dictionary must live next to remote checkpoints)."""
+        from bigdl_tpu.utils import fs
+        fs.atomic_write(path,
+                        json.dumps({"word2index": self.word2index}).encode())
 
     @staticmethod
     def load(path: str) -> "Dictionary":
+        from bigdl_tpu.utils import fs
         d = Dictionary()
-        with open(path) as f:
-            d.word2index = json.load(f)["word2index"]
+        with fs.open_file(path, "rb") as f:
+            d.word2index = json.loads(f.read().decode())["word2index"]
         d.index2word = {i: w for w, i in d.word2index.items()}
         d._unk_index = len(d.word2index)
         return d

@@ -54,10 +54,10 @@ def main(argv=None) -> None:
     dictionary = text.Dictionary(token_lists, vocab_size=args.vocabSize)
     if args.checkpoint:
         # the evaluation CLI must reuse THIS word->index mapping (the
-        # reference Train saves the dictionary next to the model)
-        import os
-        os.makedirs(args.checkpoint, exist_ok=True)
-        dictionary.save(os.path.join(args.checkpoint, "dictionary.json"))
+        # reference Train saves the dictionary next to the model); fs.join
+        # keeps gs://... checkpoint dirs working
+        from bigdl_tpu.utils import fs
+        dictionary.save(fs.join(args.checkpoint, "dictionary.json"))
     vocab = dictionary.vocab_size()
     pad_label = dictionary.get_index(text.SENTENCE_END) + 1
 

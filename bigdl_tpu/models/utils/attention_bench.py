@@ -1,0 +1,80 @@
+"""Long-context attention benchmark: the flash kernels' memory claim,
+measured (VERDICT r1: a 16k-token causal TRAIN step must fit where a
+full-score-matrix backward cannot).
+
+    python -m bigdl_tpu.models.utils.attention_bench -t 16384
+    python -m bigdl_tpu.models.utils.attention_bench -t 4096 --naive
+
+Prints one JSON line per run: step time for a causal flash-attention
+forward+backward at (B, H, T, D), and — with ``--naive`` — the same for
+the O(T^2) XLA attention so the crossover is visible.  On a TPU the
+naive path runs out of HBM orders of magnitude before the flash path
+does; both paths share the bf16 qkv inputs.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def _step_time(fn, q, k, v, iters: int = 5) -> float:
+    import jax
+
+    g = jax.jit(jax.grad(lambda q, k, v: fn(q, k, v).astype("float32").sum(),
+                         argnums=(0, 1, 2)))
+    out = g(q, k, v)  # compile
+    _ = float(out[0].astype("float32").sum())  # hard sync
+    t0 = time.perf_counter()
+    for _i in range(iters):
+        out = g(q, k, v)
+    _ = float(out[0].astype("float32").sum())
+    return (time.perf_counter() - t0) / iters
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Flash-attention train-step bench")
+    p.add_argument("-t", "--seqLen", type=int, default=16384)
+    p.add_argument("-b", "--batch", type=int, default=1)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--headDim", type=int, default=128)
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--naive", action="store_true",
+                   help="also time the O(T^2) XLA attention")
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu.nn.attention import dot_product_attention
+    from bigdl_tpu.ops import flash_attention
+
+    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    rng = np.random.RandomState(0)
+    shape = (args.batch, args.heads, args.seqLen, args.headDim)
+    q = jnp.asarray(rng.randn(*shape), dt)
+    k = jnp.asarray(rng.randn(*shape), dt)
+    v = jnp.asarray(rng.randn(*shape), dt)
+
+    flash_s = _step_time(
+        lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
+    tokens_s = args.batch * args.seqLen / flash_s
+    print(json.dumps({"metric": "flash_causal_train_step", "impl": "flash",
+                      "seq_len": args.seqLen, "batch": args.batch,
+                      "heads": args.heads, "head_dim": args.headDim,
+                      "dtype": args.dtype, "step_s": round(flash_s, 5),
+                      "tokens_per_s": round(tokens_s, 1)}))
+    if args.naive:
+        naive_s = _step_time(
+            lambda q, k, v: dot_product_attention(q, k, v, causal=True),
+            q, k, v)
+        print(json.dumps({"metric": "flash_causal_train_step",
+                          "impl": "naive_xla", "seq_len": args.seqLen,
+                          "step_s": round(naive_s, 5),
+                          "tokens_per_s": round(
+                              args.batch * args.seqLen / naive_s, 1)}))
+
+
+if __name__ == "__main__":
+    main()
