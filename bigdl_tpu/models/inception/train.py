@@ -22,6 +22,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--model", default=None)
     p.add_argument("--state", default=None)
+    p.add_argument("--resume", default=None,
+                   help="checkpoint dir: resume from its newest model/state pair")
     p.add_argument("-b", "--batchSize", type=int, default=32)
     p.add_argument("-i", "--maxIteration", type=int, default=62000)
     p.add_argument("-r", "--learningRate", type=float, default=0.01)
@@ -55,6 +57,8 @@ def _synthetic_records(n: int, seed: int = 0):
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    from bigdl_tpu.models.utils import resolve_resume
+    resolve_resume(args)
     logging.basicConfig(level=logging.INFO)
 
     from bigdl_tpu import Engine, nn

@@ -18,6 +18,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default=None, help="checkpoint dir")
     p.add_argument("--model", default=None, help="model snapshot to resume")
     p.add_argument("--state", default=None, help="state snapshot to resume")
+    p.add_argument("--resume", default=None,
+                   help="checkpoint dir: resume from its newest model/state pair")
     p.add_argument("-b", "--batchSize", type=int, default=128)
     p.add_argument("-e", "--maxEpoch", type=int, default=10)
     p.add_argument("-r", "--learningRate", type=float, default=0.05)
@@ -30,6 +32,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    from bigdl_tpu.models.utils import resolve_resume
+    resolve_resume(args)
     logging.basicConfig(level=logging.INFO)
 
     from bigdl_tpu import Engine, nn

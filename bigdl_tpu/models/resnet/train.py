@@ -15,6 +15,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint", default=None)
     p.add_argument("--model", default=None)
     p.add_argument("--state", default=None)
+    p.add_argument("--resume", default=None,
+                   help="checkpoint dir: resume from its newest model/state pair")
     p.add_argument("-b", "--batchSize", type=int, default=128)
     p.add_argument("-e", "--nepochs", type=int, default=165)
     p.add_argument("--depth", type=int, default=20, help="6n+2 for cifar10")
@@ -29,6 +31,8 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    from bigdl_tpu.models.utils import resolve_resume
+    resolve_resume(args)
     logging.basicConfig(level=logging.INFO)
 
     from bigdl_tpu import Engine, nn

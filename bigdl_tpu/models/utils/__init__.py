@@ -1,0 +1,22 @@
+
+
+def resolve_resume(args) -> None:
+    """--resume <ckpt-dir>: point --model/--state at the directory's
+    newest checkpoint pair (any fs scheme).  An empty/missing directory
+    falls through to a cold start, so one command line covers both the
+    first launch and scheduler restarts (the reference's
+    checkpoint-and-restart cycle, models/lenet/Train.scala:55-68).
+    Explicit --model/--state conflict with --resume and error out."""
+    if not getattr(args, "resume", None):
+        return
+    if getattr(args, "model", None) or getattr(args, "state", None):
+        raise SystemExit("--resume picks the newest checkpoint itself; "
+                         "drop --model/--state (or drop --resume)")
+    from bigdl_tpu.utils import file_io
+    found = file_io.latest_checkpoint(args.resume)
+    if found is None:
+        import logging
+        logging.getLogger("bigdl_tpu").info(
+            "no checkpoints under %s yet: starting fresh", args.resume)
+        return
+    args.model, args.state = found[0], found[1]

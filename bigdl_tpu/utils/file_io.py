@@ -180,6 +180,34 @@ def load(path: str) -> Any:
         return pickle.load(f)
 
 
+def latest_checkpoint(directory: str):
+    """Newest ``model.<n>`` / ``state.<n>`` pair written by
+    ``Optimizer.set_checkpoint`` under ``directory`` (any fs scheme), as
+    ``(model_path, state_path, n)`` — or None when the directory holds no
+    complete pair.  The resume counterpart of the reference's
+    checkpoint-and-restart cycle (models/lenet/Train.scala:55-68 loads
+    model.<n> + state.<n> by hand)."""
+    try:
+        names = fs.listdir(directory)
+    except FileNotFoundError:
+        return None  # no checkpoints yet; scheme/permission errors raise
+    models, states = set(), set()
+    for name in names:
+        stem, _, idx = name.partition(".")
+        if not idx.isdigit():
+            continue
+        if stem == "model":
+            models.add(int(idx))
+        elif stem == "state":
+            states.add(int(idx))
+    complete = sorted(models & states)
+    if not complete:
+        return None
+    n = complete[-1]
+    return (fs.join(directory, f"model.{n}"),
+            fs.join(directory, f"state.{n}"), n)
+
+
 # --------------------------------------------------------------------- #
 # module IO                                                             #
 # --------------------------------------------------------------------- #

@@ -49,6 +49,10 @@ class FileSystem:
         """Replace dst with src (atomic where the backend supports it)."""
         raise NotImplementedError
 
+    def listdir(self, path: str) -> list:
+        """Entry names directly under ``path`` (no scheme, no parents)."""
+        raise NotImplementedError
+
 
 class LocalFileSystem(FileSystem):
     def open(self, path: str, mode: str = "rb") -> BinaryIO:
@@ -68,6 +72,9 @@ class LocalFileSystem(FileSystem):
 
     def rename(self, src: str, dst: str) -> None:
         os.replace(src, dst)
+
+    def listdir(self, path: str) -> list:
+        return os.listdir(path)
 
 
 class MemoryFileSystem(FileSystem):
@@ -112,6 +119,12 @@ class MemoryFileSystem(FileSystem):
         with self._lock:
             self._blobs[dst] = self._blobs.pop(src)
 
+    def listdir(self, path: str) -> list:
+        prefix = path.rstrip("/") + "/"
+        with self._lock:
+            return sorted({k[len(prefix):].split("/")[0]
+                           for k in self._blobs if k.startswith(prefix)})
+
 
 class FsspecFileSystem(FileSystem):
     """Adapter for any fsspec-supported scheme (gs, s3, hdfs, ...)."""
@@ -136,6 +149,10 @@ class FsspecFileSystem(FileSystem):
 
     def rename(self, src: str, dst: str) -> None:
         self._fs.mv(f"{self._scheme}://{src}", f"{self._scheme}://{dst}")
+
+    def listdir(self, path: str) -> list:
+        entries = self._fs.ls(f"{self._scheme}://{path}", detail=False)
+        return sorted({e.rstrip("/").rsplit("/", 1)[-1] for e in entries})
 
 
 _local = LocalFileSystem()
@@ -185,6 +202,11 @@ def makedirs(path: str) -> None:
 def remove(path: str) -> None:
     fs, p = get_filesystem(path)
     fs.remove(p)
+
+
+def listdir(path: str) -> list:
+    fs, p = get_filesystem(path)
+    return fs.listdir(p)
 
 
 def join(base: str, *parts: str) -> str:

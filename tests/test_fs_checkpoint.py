@@ -198,3 +198,29 @@ def test_encode_value_accepts_jax_arrays():
     nested = _encode_value([jnp.zeros((2,)), 5])
     assert isinstance(nested, dict) and nested["__kind__"] == "list"
     assert isinstance(nested["items"][0], np.ndarray)
+
+
+def test_latest_checkpoint_and_cli_resume(tmp_path, capsys):
+    """--resume <dir> finds the newest model/state pair on any fs scheme
+    (local here; memory:// below) and the lenet CLI trains on from it."""
+    from bigdl_tpu.models.lenet import train as lenet_train
+    from bigdl_tpu.utils.file_io import latest_checkpoint
+
+    ckpt = tmp_path / "ckpt"
+    lenet_train.main(["--synthetic", "-e", "1", "-b", "64",
+                      "--checkpoint", str(ckpt)])
+    found = latest_checkpoint(str(ckpt))
+    assert found is not None
+    model_p, state_p, n = found
+    assert model_p.endswith(f"model.{n}") and state_p.endswith(f"state.{n}")
+    # resume: runs further epochs starting from the stored driver state
+    lenet_train.main(["--synthetic", "-e", "2", "-b", "64",
+                      "--resume", str(ckpt)])
+
+    # memory:// scheme variant of the discovery
+    fs.atomic_write("memory://lc/model.3", b"x")
+    fs.atomic_write("memory://lc/state.3", b"y")
+    fs.atomic_write("memory://lc/model.7", b"x")  # no state.7: incomplete
+    found = latest_checkpoint("memory://lc")
+    assert found == ("memory://lc/model.3", "memory://lc/state.3", 3)
+    assert latest_checkpoint("memory://definitely-empty-dir") is None
