@@ -50,11 +50,38 @@ class Optimizer:
         self.validation_summary = None
         self.state: dict = {}
         self.metrics = Metrics()
+        self.compute_dtype = None  # e.g. jnp.bfloat16; None = full f32
 
     # -- builder methods (reference names, pythonized) ------------------- #
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
         self.optim_method = method
         return self
+
+    def set_compute_dtype(self, dtype) -> "Optimizer":
+        """Mixed precision: run forward/backward with float params cast to
+        ``dtype`` (bf16 feeds the MXU at full rate) while the master
+        weights and optimizer state stay f32 — the TPU rendering of the
+        reference's fp16-transport / f32-state split
+        (parameters/AllReduceParameter.scala).  Gradients arrive f32
+        (the cast's own vjp does the up-cast)."""
+        self.compute_dtype = dtype
+        return self
+
+    def _cast_for_compute(self, params):
+        if self.compute_dtype is None:
+            return params
+        dt = self.compute_dtype
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(dt) if jnp.asarray(a).dtype == jnp.float32
+            else a, params)
+
+    def _outputs_to_f32(self, out):
+        """Loss inputs in f32 regardless of the compute dtype; identity in
+        the pure-f32 path (no traversal added to the traced graph)."""
+        if self.compute_dtype is None:
+            return out
+        return jax.tree_util.tree_map(
+            lambda o: jnp.asarray(o).astype(jnp.float32), out)
 
     def set_end_when(self, trigger: Trigger) -> "Optimizer":
         self.end_when = trigger
@@ -211,11 +238,13 @@ class LocalOptimizer(Optimizer):
 
     def _build_step(self):
         model, criterion, method = self.model, self.criterion, self.optim_method
+        cast = self._cast_for_compute
 
         def loss_fn(params, buffers, data, labels, rng):
-            out, new_buffers = model.apply(params, data, buffers=buffers,
+            out, new_buffers = model.apply(cast(params), data, buffers=buffers,
                                            training=True, rng=rng)
-            return criterion.loss(out, labels), new_buffers
+            return criterion.loss(self._outputs_to_f32(out), labels), \
+                new_buffers
 
         def step(params, buffers, opt_state, data, labels, rng, epoch):
             (loss, new_buffers), grads = jax.value_and_grad(
@@ -303,8 +332,9 @@ class LocalOptimizer(Optimizer):
         @jax.jit
         def val_and_grad(flat):
             def loss_fn(fl):
-                out, _ = model.apply(unravel(fl), data, buffers=buffers, training=True)
-                return criterion.loss(out, labels)
+                out, _ = model.apply(self._cast_for_compute(unravel(fl)),
+                                     data, buffers=buffers, training=True)
+                return criterion.loss(self._outputs_to_f32(out), labels)
             return jax.value_and_grad(loss_fn)(flat)
 
         def feval(flat):

@@ -102,11 +102,13 @@ class DistriOptimizer(Optimizer):
     # ------------------------------------------------------------------ #
     def _build_step(self, arp: AllReduceParameter):
         model, criterion, method = self.model, self.criterion, self.optim_method
+        cast = self._cast_for_compute
 
         def loss_fn(params, buffers, data, labels, rng):
-            out, new_buffers = model.apply(params, data, buffers=buffers,
+            out, new_buffers = model.apply(cast(params), data, buffers=buffers,
                                            training=True, rng=rng)
-            return criterion.loss(out, labels), new_buffers
+            return criterion.loss(self._outputs_to_f32(out), labels), \
+                new_buffers
 
         def step(w_shard, opt_state, buffers, data, labels, rng, epoch):
             # per-device RNG (each reference thread-replica drew its own noise)

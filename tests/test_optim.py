@@ -218,3 +218,52 @@ class TestLocalOptimizer:
         opt.optimize()
         assert opt.state["epoch"] == 4  # stopped after finishing 3 epochs
         assert opt.state["neval"] == 3 * 2 + 1
+
+
+class TestMixedPrecision:
+    """set_compute_dtype: bf16 forward/backward, f32 master weights (the
+    TPU mixed-precision recipe bench.py uses, now first-class API)."""
+
+    def _job(self, cls, dtype=None, mesh=None):
+        import jax.numpy as jnp
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.dataset.transformer import SampleToBatch
+
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randn(6).astype(np.float32),
+                          np.asarray(float(i % 3) + 1, np.float32))
+                   for i in range(24)]
+        ds = DataSet.array(samples) >> SampleToBatch(8, drop_last=True)
+        m = nn.Sequential(nn.Linear(6, 16), nn.Tanh(), nn.Linear(16, 3),
+                          nn.LogSoftMax())
+        kwargs = {"mesh": mesh} if mesh is not None else {}
+        opt = cls(m, ds, nn.ClassNLLCriterion(), **kwargs)
+        opt.set_optim_method(SGD(learning_rate=0.1)) \
+           .set_end_when(Trigger.max_iteration(6))
+        if dtype is not None:
+            opt.set_compute_dtype(dtype)
+        model = opt.optimize()
+        return float(opt.state["loss"]), model
+
+    def test_local_bf16_compute_keeps_f32_masters(self):
+        import jax.numpy as jnp
+
+        loss16, model = self._job(LocalOptimizer, jnp.bfloat16)
+        loss32, _ = self._job(LocalOptimizer, None)
+        assert np.isfinite(loss16)
+        # master weights stay f32 despite bf16 compute
+        for leaf in jax.tree_util.tree_leaves(model.params):
+            assert leaf.dtype == jnp.float32
+        # bf16 rounding wiggles the trajectory but not the outcome
+        assert abs(loss16 - loss32) < 0.05 * max(abs(loss32), 1.0)
+
+    def test_distri_bf16_compute(self):
+        import jax.numpy as jnp
+        from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+        from bigdl_tpu.parallel.mesh import DATA_AXIS
+
+        mesh = create_mesh({DATA_AXIS: 4}, devices=jax.devices()[:4])
+        loss16, model = self._job(DistriOptimizer, jnp.bfloat16, mesh=mesh)
+        loss32, _ = self._job(DistriOptimizer, None, mesh=mesh)
+        assert np.isfinite(loss16)
+        assert abs(loss16 - loss32) < 0.05 * max(abs(loss32), 1.0)
