@@ -128,13 +128,28 @@ def run_perf(model_name: str, batch_size: int, iterations: int,
 
 def run_scaling_sweep(model_name: str, per_chip_batch: int, iterations: int,
                       mesh_sizes: list, data_type: str = "random",
-                      warmup: int = 2, data_format: str = "NCHW") -> dict:
+                      warmup: int = 2, data_format: str = "NCHW",
+                      real_devices: bool = False) -> dict:
     """Weak-scaling sweep (ref DistriOptimizerPerf's role; target metric
     BASELINE.md 'allreduce scaling eff').  Fixed per-chip batch; global
     batch grows with the mesh.  efficiency(N) = t_step(N0) / t_step(N) —
-    1.0 is perfect weak scaling; the gap is collective + overhead share."""
-    from bigdl_tpu.utils.engine import ensure_virtual_devices
-    devices = ensure_virtual_devices(max(mesh_sizes))
+    1.0 is perfect weak scaling; the gap is collective + overhead share.
+
+    ``real_devices=True`` (the ``--real-devices`` CLI flag) initialises the
+    default accelerator backend and sweeps over the actual chips — the pod
+    mode BASELINE.md's metric wants.  The default stays virtual-CPU so the
+    sweep runs anywhere (and cannot hang on an unreachable accelerator)."""
+    if real_devices:
+        import jax
+        devices = list(jax.devices())
+        if len(devices) < max(mesh_sizes):
+            raise RuntimeError(
+                f"--real-devices: host has {len(devices)} "
+                f"{devices[0].platform if devices else ''} device(s), "
+                f"sweep needs {max(mesh_sizes)}")
+    else:
+        from bigdl_tpu.utils.engine import ensure_virtual_devices
+        devices = ensure_virtual_devices(max(mesh_sizes))
     from bigdl_tpu import nn
     from bigdl_tpu.optim import SGD, Trigger
     from bigdl_tpu.parallel import DistriOptimizer, create_mesh
@@ -183,6 +198,9 @@ def main(argv=None) -> None:
     p.add_argument("--dataFormat", default="NCHW", choices=["NCHW", "NHWC"],
                    help="activation layout (NHWC = TPU-fast channels-last)")
     p.add_argument("--distributed", action="store_true")
+    p.add_argument("--real-devices", action="store_true",
+                   help="sweep over the host's real accelerator chips "
+                        "instead of the virtual CPU pool (pod mode)")
     p.add_argument("--mesh", default=None,
                    help="comma-separated mesh sizes for the scaling sweep, "
                         "e.g. 1,2,4,8")
@@ -193,7 +211,8 @@ def main(argv=None) -> None:
         sizes = [int(s) for s in args.mesh.split(",")]
         result = run_scaling_sweep(args.model, args.batchSize, args.iteration,
                                    sizes, data_type=args.dataType,
-                                   data_format=args.dataFormat)
+                                   data_format=args.dataFormat,
+                                   real_devices=args.real_devices)
         for r in result["sweep"]:
             print(f"mesh {r['mesh']:>3}: {r['mean_step_s']*1000:8.1f} ms/step, "
                   f"{r['records_s']:9.1f} records/s, "

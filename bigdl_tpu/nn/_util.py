@@ -27,6 +27,24 @@ def fold_rng(rng, i: int):
     return None if rng is None else jax.random.fold_in(rng, i)
 
 
+def match_compute_dtype(x, w):
+    """AMP-style operand alignment for MXU-feeding ops: when the weight is
+    a float of different precision than the float input, cast the input to
+    the weight's dtype.  Mixed precision casts *params* to the compute
+    dtype (optim.Optimizer.set_compute_dtype); aligning at the layer is
+    what makes the matmul/conv actually run there — jnp's silent promotion
+    would up-cast the bf16 weight back to f32, and lax.conv would reject
+    the mismatch outright.  Inputs whose float payload is not resumable in
+    low precision (1-based LookupTable/embedding ids riding float32) never
+    reach this helper: id-consuming layers convert to int before any
+    weight touches the value."""
+    if (jnp.issubdtype(x.dtype, jnp.floating)
+            and jnp.issubdtype(w.dtype, jnp.floating)
+            and x.dtype != w.dtype):
+        return x.astype(w.dtype)
+    return x
+
+
 def same_pad(size: int, kernel: int, stride: int) -> tuple[int, int]:
     """SAME-style padding pair for one spatial dim."""
     out = -(-size // stride)

@@ -17,6 +17,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn._util import match_compute_dtype
 
 NEG_INF = float("-inf")
 
@@ -168,6 +169,9 @@ class MultiHeadAttention(Module):
         return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
 
     def project_qkv(self, params, q_in, k_in, v_in):
+        q_in = match_compute_dtype(jnp.asarray(q_in), params["wq"])
+        k_in = match_compute_dtype(jnp.asarray(k_in), params["wk"])
+        v_in = match_compute_dtype(jnp.asarray(v_in), params["wv"])
         q = q_in @ params["wq"]
         k = k_in @ params["wk"]
         v = v_in @ params["wv"]

@@ -24,6 +24,7 @@ from jax import lax
 
 from bigdl_tpu.nn.initialization import Default, InitializationMethod
 from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn._util import match_compute_dtype
 
 
 def _dn(data_format: str):
@@ -89,6 +90,7 @@ class SpatialConvolution(Module):
         squeeze = x.ndim == 3
         if squeeze:  # CHW -> NCHW (the reference accepts 3-D input)
             x = x[None]
+        x = match_compute_dtype(x, params["weight"])
         y = lax.conv_general_dilated(
             x, params["weight"],
             window_strides=(self.stride_h, self.stride_w),
@@ -126,6 +128,7 @@ class SpatialDilatedConvolution(SpatialConvolution):
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
+        x = match_compute_dtype(x, params["weight"])
         y = lax.conv_general_dilated(
             x, params["weight"],
             window_strides=(self.stride_h, self.stride_w),
@@ -183,6 +186,7 @@ class SpatialFullConvolution(Module):
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
+        x = match_compute_dtype(x, params["weight"])
         w = params["weight"]
         # (I, O/g, kh, kw) -> flip spatial, swap to (O, I/g, kh, kw)
         w = jnp.flip(w, axis=(-2, -1))
@@ -270,8 +274,10 @@ class SpatialConvolutionMap(Module):
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
+        w = params["weight"] * self._mask.astype(params["weight"].dtype)
+        x = match_compute_dtype(x, w)
         y = lax.conv_general_dilated(
-            x, params["weight"] * self._mask,
+            x, w,
             window_strides=(self.stride_h, self.stride_w),
             padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
             dimension_numbers=_dn("NCHW"),

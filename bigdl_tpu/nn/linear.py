@@ -17,6 +17,7 @@ import jax.numpy as jnp
 
 from bigdl_tpu.nn.initialization import Default, InitializationMethod, Xavier
 from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn._util import match_compute_dtype
 from bigdl_tpu.utils.table import Table
 
 
@@ -44,6 +45,7 @@ class Linear(Module):
         return p
 
     def f(self, params, x, **kw):
+        x = match_compute_dtype(jnp.asarray(x), params["weight"])
         y = x @ params["weight"].T
         if self.with_bias:
             y = y + params["bias"]

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from bigdl_tpu.nn.module import Module
+from bigdl_tpu.nn._util import match_compute_dtype
 from bigdl_tpu.nn.table_ops import CAddTable
 
 
@@ -80,6 +81,8 @@ class RnnCell(Cell):
         return jnp.zeros((batch, self.hidden_size), dtype)
 
     def step(self, params, x_t, h, *, training=False, rng=None):
+        x_t = match_compute_dtype(x_t, params["w_ih"])
+        h = match_compute_dtype(h, params["w_hh"])
         h_new = self.activation.f({}, x_t @ params["w_ih"] + h @ params["w_hh"] + params["bias"])
         return h_new, h_new
 
@@ -110,6 +113,8 @@ class LSTM(Cell):
     def step(self, params, x_t, state, *, training=False, rng=None):
         h, c = state
         H = self.hidden_size
+        x_t = match_compute_dtype(x_t, params["w_ih"])
+        h = match_compute_dtype(h, params["w_hh"])
         gates = x_t @ params["w_ih"] + h @ params["w_hh"] + params["bias"]
         gates = self._gate_dropout(gates, training, rng)
         i, f, g, o = jnp.split(gates, 4, axis=-1)
@@ -144,6 +149,8 @@ class GRU(Cell):
 
     def step(self, params, x_t, h, *, training=False, rng=None):
         H = self.hidden_size
+        x_t = match_compute_dtype(x_t, params["w_ih"])
+        h = match_compute_dtype(h, params["w_hh"])
         xi = x_t @ params["w_ih"] + params["bias"]
         xi = self._gate_dropout(xi, training, rng)
         hh = h @ params["w_hh"]
@@ -173,7 +180,14 @@ class Recurrent(Module):
 
     def f(self, params, x, *, training=False, rng=None, **kw):
         B, T = x.shape[0], x.shape[1]
-        state0 = self.cell.init_state(B, x.dtype)
+        # the scan carry must keep one dtype across steps: the cell GEMMs
+        # run in the weight dtype (match_compute_dtype), so the state
+        # starts there too — under bf16 compute a f32 state would flip
+        # dtype after the first step and fail scan's carry check
+        float_leaves = [l for l in jax.tree_util.tree_leaves(params["cell"])
+                        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)]
+        state_dtype = float_leaves[0].dtype if float_leaves else x.dtype
+        state0 = self.cell.init_state(B, state_dtype)
         xs = jnp.swapaxes(x, 0, 1)  # (T, B, F)
         use_rng = rng is not None and getattr(self.cell, "p", 0.0) > 0.0 and training
         keys = jax.random.split(rng, T) if use_rng else jnp.zeros((T, 2), dtype=jnp.uint32)

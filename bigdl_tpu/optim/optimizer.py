@@ -68,6 +68,12 @@ class Optimizer:
         return self
 
     def _cast_for_compute(self, params):
+        # input batches are deliberately NOT cast alongside the params:
+        # the MXU-feeding layers align their input to the weight dtype
+        # themselves (nn/_util.py match_compute_dtype) — a blanket
+        # float-input cast would silently corrupt float-encoded
+        # LookupTable/embedding ids above bf16's exact-integer range
+        # (dataset/text.py emits 1-based ids as float32).
         if self.compute_dtype is None:
             return params
         dt = self.compute_dtype

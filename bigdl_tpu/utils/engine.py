@@ -96,10 +96,14 @@ _state = _EngineState()
 
 
 def ensure_virtual_devices(n: int):
-    """Return >= ``n`` devices, forcing virtual CPU devices when the host
-    has fewer real chips (the analog of the reference's simulated-multinode
-    trick: DistriOptimizerSpec runs 4 "nodes" as 4 partitions in one
-    local[1] JVM, optim/DistriOptimizerSpec.scala:39-43).
+    """Return >= ``n`` devices: already-initialised real accelerator
+    devices when the process has enough of them, else a virtual CPU pool
+    (the analog of the reference's simulated-multinode trick:
+    DistriOptimizerSpec runs 4 "nodes" as 4 partitions in one local[1]
+    JVM, optim/DistriOptimizerSpec.scala:39-43).  This function never
+    initialises an accelerator backend itself — on a fresh process it
+    selects the cpu platform, so an absent/unreachable TPU cannot hang
+    the bootstrap.
 
     ``--xla_force_host_platform_device_count`` only takes effect if set
     before the first backend initialisation in the process, hence the env
@@ -117,16 +121,46 @@ def ensure_virtual_devices(n: int):
             flags + f" --xla_force_host_platform_device_count={want}").strip()
     import jax
 
-    devices = list(jax.devices())
-    if len(devices) < n:
+    try:
+        from jax._src import xla_bridge as _xb
+        initialized = _xb.backends_are_initialized()
+    except Exception:
+        initialized = False
+
+    if initialized:
+        # backends already live in this process: reuse real accelerator
+        # devices when the host actually has enough of them (no new
+        # backend is dialed — jax.devices() is a cache read here).
         try:
-            devices = list(jax.devices("cpu"))
-        except RuntimeError as e:
-            raise RuntimeError(
-                f"need {n} devices and the cpu fallback backend is "
-                f"unavailable — a jax backend was initialised before this "
-                f"call, so XLA_FLAGS was set too late; restart and request "
-                f"the virtual devices before any other jax use.") from e
+            devices = list(jax.devices())
+            if len(devices) >= n:
+                return devices[:n]
+        except RuntimeError:
+            pass
+    elif str(jax.config.jax_platforms or "") != "cpu":
+        # First backend use in the process: select the cpu platform
+        # outright.  jax.config wins over the JAX_PLATFORMS env var (site
+        # customisations may pin that to an accelerator), and never
+        # initialising the accelerator also means a slow or unreachable
+        # tunneled TPU cannot hang or fail this bootstrap — the exact
+        # failure mode that turned round 1's multichip check red.  Must
+        # be exactly "cpu": a list like "axon,cpu" still initialises the
+        # accelerator backend on the first jax.devices() call.  The pin
+        # is process-global; release_virtual_devices() undoes it for
+        # callers that later want the real accelerator in this process.
+        global _pin_active, _pinned_prior_platforms
+        _pin_active = True
+        _pinned_prior_platforms = jax.config.jax_platforms
+        jax.config.update("jax_platforms", "cpu")
+
+    try:
+        devices = list(jax.devices("cpu"))
+    except RuntimeError as e:
+        raise RuntimeError(
+            f"need {n} devices and the cpu fallback backend is "
+            f"unavailable — a jax backend was initialised before this "
+            f"call, so XLA_FLAGS was set too late; restart and request "
+            f"the virtual devices before any other jax use.") from e
     if len(devices) < n:
         raise RuntimeError(
             f"need {n} devices; have {len(devices)} CPU virtual devices. "
@@ -134,6 +168,30 @@ def ensure_virtual_devices(n: int):
             f"was set too late — restart and request the virtual devices "
             f"before any other jax use.")
     return devices[:n]
+
+
+_pin_active = False
+_pinned_prior_platforms = None
+
+
+def release_virtual_devices() -> None:
+    """Undo ``ensure_virtual_devices``' process-global cpu-platform pin:
+    restore the prior ``jax_platforms`` setting and clear the cached
+    backend set, so the next ``jax.devices()`` re-reads it and real
+    accelerators become visible again.  Arrays created on the virtual
+    pool keep referencing their (now un-cached) cpu client and stay
+    readable — the same contract the jax ``clear_backends`` API gives.
+    No-op when nothing was pinned."""
+    global _pin_active, _pinned_prior_platforms
+    if not _pin_active:
+        return
+    import jax
+    from jax.extend.backend import clear_backends
+
+    jax.config.update("jax_platforms", _pinned_prior_platforms)
+    _pin_active = False
+    _pinned_prior_platforms = None
+    clear_backends()
 
 
 class Engine:

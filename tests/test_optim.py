@@ -267,3 +267,92 @@ class TestMixedPrecision:
         loss32, _ = self._job(DistriOptimizer, None, mesh=mesh)
         assert np.isfinite(loss16)
         assert abs(loss16 - loss32) < 0.05 * max(abs(loss32), 1.0)
+
+    def test_conv_model_bf16_compute(self):
+        """Conv models are the regression case: lax.conv_general_dilated
+        requires matching operand dtypes, so bf16 weights demand the input
+        batch be cast too (a params-only cast is a trace-time TypeError),
+        and the bf16 path must actually run in bf16, not silently promote
+        back to f32."""
+        import jax.numpy as jnp
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.dataset.transformer import SampleToBatch
+
+        rng = np.random.RandomState(0)
+        samples = [Sample(rng.randn(1, 8, 8).astype(np.float32),
+                          np.asarray(float(i % 2) + 1, np.float32))
+                   for i in range(8)]
+        ds = DataSet.array(samples) >> SampleToBatch(4, drop_last=True)
+        m = nn.Sequential(
+            nn.SpatialConvolution(1, 4, 3, 3), nn.ReLU(),
+            nn.Reshape((4 * 6 * 6,)), nn.Linear(4 * 6 * 6, 2),
+            nn.LogSoftMax())
+        opt = LocalOptimizer(m, ds, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learning_rate=0.05)) \
+           .set_end_when(Trigger.max_iteration(4)) \
+           .set_compute_dtype(jnp.bfloat16)
+        model = opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+        for leaf in jax.tree_util.tree_leaves(model.params):
+            assert leaf.dtype == jnp.float32
+
+    def test_recurrent_model_bf16_compute(self):
+        """The cell GEMMs must align operands to the weight dtype (a f32
+        one-hot input would otherwise promote the bf16 gates back to f32
+        and silently no-op the mixed precision), and the scan carry must
+        keep one dtype across steps."""
+        import jax.numpy as jnp
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.dataset.transformer import SampleToBatch
+
+        rng = np.random.RandomState(0)
+        vocab, t = 5, 4
+        samples = []
+        for i in range(8):
+            ids = rng.randint(0, vocab, size=t)
+            feat = np.zeros((t, vocab), np.float32)
+            feat[np.arange(t), ids] = 1.0
+            samples.append(Sample(feat, (ids + 1).astype(np.float32)))
+        ds = DataSet.array(samples) >> SampleToBatch(4, drop_last=True)
+        for cell in (nn.LSTM(vocab, 8), nn.GRU(vocab, 8),
+                     nn.RnnCell(vocab, 8)):
+            m = nn.Sequential(
+                nn.Recurrent(cell),
+                nn.TimeDistributed(nn.Sequential(nn.Linear(8, vocab),
+                                                 nn.LogSoftMax())))
+            opt = LocalOptimizer(
+                m, ds, nn.TimeDistributedCriterion(nn.ClassNLLCriterion(),
+                                                   True))
+            opt.set_optim_method(SGD(learning_rate=0.1)) \
+               .set_end_when(Trigger.max_iteration(3)) \
+               .set_compute_dtype(jnp.bfloat16)
+            model = opt.optimize()
+            assert np.isfinite(opt.state["loss"])
+            for leaf in jax.tree_util.tree_leaves(model.params):
+                assert leaf.dtype == jnp.float32
+        # the cell really runs in bf16: a recurrent forward with bf16
+        # params yields bf16 states, not silently-promoted f32 ones
+        rec = nn.Recurrent(nn.LSTM(vocab, 8))
+        p16 = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16),
+            rec.init(jax.random.PRNGKey(0)))
+        y = rec.f(p16, jnp.asarray(samples[0].feature)[None])
+        assert y.dtype == jnp.bfloat16
+
+    def test_float_encoded_ids_survive_bf16_compute(self):
+        """Regression: the batch must NOT be blanket-cast to the compute
+        dtype — float-encoded 1-based LookupTable ids above bf16's exact
+        integer range (256) would silently round to the wrong row.  The
+        MXU layers align dtypes at the weight instead."""
+        import jax.numpy as jnp
+
+        table = nn.LookupTable(600, 4).build(seed=0)
+        p16 = jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.bfloat16), table.params)
+        ids = jnp.asarray([[513.0, 514.0]], jnp.float32)  # not bf16-exact
+        out = np.asarray(table.f(p16, ids), np.float32)
+        want = np.asarray(table.params["weight"], np.float32)[[512, 513]]
+        np.testing.assert_allclose(out[0], want.astype(np.float32)
+                                   .astype(jnp.bfloat16).astype(np.float32),
+                                   atol=1e-2)
+        assert not np.allclose(out[0, 0], out[0, 1])  # distinct rows
