@@ -10,3 +10,4 @@ from bigdl_tpu.models.alexnet import AlexNet
 from bigdl_tpu.models.rnn import SimpleRNN
 from bigdl_tpu.models.autoencoder import Autoencoder
 from bigdl_tpu.models.textclassifier import TextClassifier
+from bigdl_tpu.models.transformer import TransformerLM

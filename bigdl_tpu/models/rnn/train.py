@@ -36,9 +36,9 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO)
 
     from bigdl_tpu import Engine, nn
-    from bigdl_tpu.dataset import DataSet, text
-    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.dataset import DataSet
     from bigdl_tpu.models.rnn import LstmLM, SimpleRNN
+    from bigdl_tpu.models.utils import lm_corpus, lm_sample_pipe
     from bigdl_tpu.optim import Loss, Optimizer, SGD, Trigger
 
     Engine.init()
@@ -48,10 +48,7 @@ def main(argv=None) -> None:
         with open(args.folder) as f:
             raw = f.read()
 
-    tokenize = text.SentenceSplitter() >> text.SentenceTokenizer() \
-        >> text.SentenceBiPadding()
-    token_lists = list(tokenize([raw]))
-    dictionary = text.Dictionary(token_lists, vocab_size=args.vocabSize)
+    token_lists, dictionary = lm_corpus(raw, args.vocabSize)
     if args.checkpoint:
         # the evaluation CLI must reuse THIS word->index mapping (the
         # reference Train saves the dictionary next to the model); fs.join
@@ -59,12 +56,7 @@ def main(argv=None) -> None:
         from bigdl_tpu.utils import fs
         dictionary.save(fs.join(args.checkpoint, "dictionary.json"))
     vocab = dictionary.vocab_size()
-    pad_label = dictionary.get_index(text.SENTENCE_END) + 1
-
-    pipe = (text.TextToLabeledSentence(dictionary)
-            >> text.LabeledSentenceToSample(vocab, fixed_length=args.seqLength,
-                                            pad_label=pad_label)
-            >> SampleToBatch(args.batchSize))
+    pipe = lm_sample_pipe(dictionary, args.seqLength, args.batchSize)
     split = int(len(token_lists) * 0.8) or 1
     train_ds = DataSet.array(token_lists[:split]) >> pipe
     val_ds = DataSet.array(token_lists[split:] or token_lists[:1]) >> pipe

@@ -1,0 +1,160 @@
+"""Decoder-only transformer language model (capability-gap fill: the
+reference's language-model family tops out at Recurrent/LSTM,
+models/rnn/SimpleRNN.scala:22 — this is the long-context successor the
+survey's §5.7 gap-fill analysis calls for, built on the same training
+surfaces: 1-based LookupTable ids in, (B, T, V) log-probs out, trained
+with TimeDistributedCriterion(ClassNLLCriterion) exactly like the RNN
+family so every Optimizer/Validator path is shared).
+
+TPU-first structure instead of a stack of OO layers:
+
+- all transformer blocks share ONE traced body via ``lax.scan`` over
+  layer-stacked parameters — compile time is O(1) in depth, and XLA still
+  pipelines the per-layer matmuls onto the MXU back-to-back;
+- the attention core is the Pallas flash kernel on TPU
+  (``bigdl_tpu.ops.flash_attention``; interpret mode elsewhere), so the
+  (T, T) score matrix never exists in HBM in forward OR backward;
+- optional ``remat`` wraps the block in ``jax.checkpoint`` — activation
+  memory O(sqrt-ish) for long sequences, the standard bandwidth/FLOPs
+  trade on HBM-bound chips;
+- pre-LayerNorm residual wiring, learned positional embedding, weight-tied
+  LM head (embedding.T) by default.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Module
+
+
+class TransformerLM(Module):
+    """Causal transformer LM over 1-based token ids.
+
+    Input: (B, T) ids in [1, vocab] (float or int — the data pipeline's
+    ``LabeledSentenceToSample(one_hot=False)`` emits 1-based floats for
+    LookupTable parity).  Output: (B, T, vocab) log-probabilities.
+    """
+
+    def __init__(self, vocab_size: int, hidden_size: int = 128,
+                 n_head: int = 4, n_layers: int = 2,
+                 ffn_size: Optional[int] = None, max_len: int = 512,
+                 dropout: float = 0.0, tie_embeddings: bool = True,
+                 remat: bool = False, attention_impl: str = "auto",
+                 block_size: Optional[int] = None):
+        super().__init__()
+        assert hidden_size % n_head == 0
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.n_head = n_head
+        self.n_layers = n_layers
+        self.ffn_size = ffn_size or 4 * hidden_size
+        self.max_len = max_len
+        self.dropout = dropout
+        self.tie_embeddings = tie_embeddings
+        self.remat = remat
+        # attention plumbing (projections + kernel choice) is shared with
+        # the standalone nn.MultiHeadAttention so there is one hot path
+        self._mha = nn.MultiHeadAttention(
+            hidden_size, n_head, causal=True, with_bias=True,
+            attention_impl=attention_impl, block_size=block_size)
+
+    # -------------------------------------------------------------- #
+    def _init_block(self, rng):
+        ks = jax.random.split(rng, 3)
+        h, f = self.hidden_size, self.ffn_size
+        std_h, std_f = 1.0 / math.sqrt(h), 1.0 / math.sqrt(f)
+        return {
+            "ln1": {"weight": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+            "attn": self._mha.init(ks[0]),
+            "ln2": {"weight": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+            "w1": jax.random.uniform(ks[1], (h, f), jnp.float32,
+                                     -std_h, std_h),
+            "b1": jnp.zeros((f,)),
+            "w2": jax.random.uniform(ks[2], (f, h), jnp.float32,
+                                     -std_f, std_f),
+            "b2": jnp.zeros((h,)),
+        }
+
+    def init(self, rng):
+        k_emb, k_pos, k_head, k_blocks = jax.random.split(rng, 4)
+        h, v = self.hidden_size, self.vocab_size
+        std = 1.0 / math.sqrt(h)
+        # one vmapped init -> parameters already stacked on a leading
+        # layer axis, the exact layout lax.scan consumes
+        blocks = jax.vmap(self._init_block)(
+            jax.random.split(k_blocks, self.n_layers))
+        p = {
+            "embed": jax.random.normal(k_emb, (v, h)) * std,
+            "pos": jax.random.normal(k_pos, (self.max_len, h)) * std,
+            "blocks": blocks,
+            "ln_f": {"weight": jnp.ones((h,)), "bias": jnp.zeros((h,))},
+        }
+        if not self.tie_embeddings:
+            p["head"] = jax.random.uniform(k_head, (h, v), jnp.float32,
+                                           -std, std)
+        return p
+
+    # -------------------------------------------------------------- #
+    @staticmethod
+    def _layer_norm(p, x):
+        from bigdl_tpu.nn.normalization import layer_norm
+        return layer_norm(x, p["weight"], p["bias"])
+
+    def _block(self, bp, x, training: bool, rng):
+        mha = self._mha
+        a = self._layer_norm(bp["ln1"], x)
+        q, k, v = mha.project_qkv(bp["attn"], a, a, a)
+        if mha.attention_impl == "flash":
+            from bigdl_tpu.ops import flash_attention
+            bs = mha.block_size or 128
+            o = flash_attention(q, k, v, causal=True, block_q=bs, block_k=bs)
+        else:
+            from bigdl_tpu.nn.attention import dot_product_attention
+            o = dot_product_attention(q, k, v, causal=True)
+        o = mha.project_out(bp["attn"], o)
+        if training and self.dropout > 0.0:
+            rng, sub = jax.random.split(rng)
+            keep = 1.0 - self.dropout
+            o = o * jax.random.bernoulli(sub, keep, o.shape) / keep
+        x = x + o
+        m = self._layer_norm(bp["ln2"], x)
+        m = jax.nn.gelu(m @ bp["w1"] + bp["b1"], approximate=True)
+        m = m @ bp["w2"] + bp["b2"]
+        if training and self.dropout > 0.0:
+            rng, sub = jax.random.split(rng)
+            keep = 1.0 - self.dropout
+            m = m * jax.random.bernoulli(sub, keep, m.shape) / keep
+        return x + m
+
+    def f(self, params, x, *, training: bool = False, rng=None):
+        ids = jnp.asarray(x)
+        if jnp.issubdtype(ids.dtype, jnp.floating):
+            ids = ids.astype(jnp.int32)
+        ids = ids - 1  # 1-based API edge -> 0-based gather
+        t = ids.shape[-1]
+        h = params["embed"][ids] + params["pos"][:t]
+        if rng is None:
+            if training and self.dropout > 0.0:
+                raise ValueError(
+                    "TransformerLM with dropout>0 needs an rng in training "
+                    "mode — a silent fixed key would apply the identical "
+                    "dropout mask every step")
+            rng = jax.random.PRNGKey(0)
+
+        block = (jax.checkpoint(self._block, static_argnums=(2,))
+                 if self.remat else self._block)
+        keys = jax.random.split(rng, self.n_layers)
+        h, _ = jax.lax.scan(
+            lambda carry, layer: (block(layer[0], carry, training, layer[1]),
+                                  None),
+            h, (params["blocks"], keys))
+        h = self._layer_norm(params["ln_f"], h)
+        head = (params["embed"].T.astype(h.dtype) if self.tie_embeddings
+                else params["head"].astype(h.dtype))
+        logits = h @ head
+        return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

@@ -1,8 +1,9 @@
-"""SimpleRNN language-model evaluation CLI (ref models/rnn/Test.scala:
-load a trained model and report per-timestep loss on held-out text).
+"""Transformer language-model evaluation CLI (pairs with
+models/transformer/train.py the way every reference family ships Train and
+Test mains, e.g. models/rnn/Test.scala: load checkpoint, report the
+per-timestep loss on held-out text).
 
-    python -m bigdl_tpu.models.rnn.test --model model.ckpt -f input.txt
-    python -m bigdl_tpu.models.rnn.test --model model.ckpt --synthetic
+    python -m bigdl_tpu.models.transformer.test --model model.ckpt --synthetic
 """
 from __future__ import annotations
 
@@ -13,7 +14,7 @@ from bigdl_tpu.models.rnn.train import _SYNTH
 
 
 def main(argv=None) -> None:
-    p = argparse.ArgumentParser(description="Evaluate SimpleRNN LM")
+    p = argparse.ArgumentParser(description="Evaluate transformer LM")
     p.add_argument("--model", required=True, help="trained model file")
     p.add_argument("--dictionary", default=None,
                    help="dictionary.json saved by the train CLI; without "
@@ -43,7 +44,7 @@ def main(argv=None) -> None:
     token_lists, dictionary = lm_corpus(raw, args.vocabSize,
                                         dictionary=loaded)
     ds = DataSet.array(token_lists) >> lm_sample_pipe(
-        dictionary, args.seqLength, args.batchSize)
+        dictionary, args.seqLength, args.batchSize, one_hot=False)
 
     model = nn.Module.load(args.model)
     criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)

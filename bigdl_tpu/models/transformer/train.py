@@ -1,0 +1,94 @@
+"""Transformer language-model training CLI (the long-context counterpart
+of models/rnn/train.py — the reference's LM family is RNN/LSTM,
+models/rnn/Train.scala:62-90; the data pipeline, optimizer surface, and
+checkpoint contract here are identical so the families swap in place).
+
+    python -m bigdl_tpu.models.transformer.train --synthetic -e 2
+    python -m bigdl_tpu.models.transformer.train -f input.txt --vocabSize 4000
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+
+from bigdl_tpu.models.rnn.train import _SYNTH
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Train transformer language model")
+    p.add_argument("-f", "--folder", default=None, help="input text file")
+    p.add_argument("--checkpoint", default=None)
+    p.add_argument("--model", default=None)
+    p.add_argument("--state", default=None)
+    p.add_argument("--resume", default=None,
+                   help="checkpoint dir: auto-load the newest model/state pair")
+    p.add_argument("-b", "--batchSize", type=int, default=32)
+    p.add_argument("-e", "--maxEpoch", type=int, default=10)
+    p.add_argument("-r", "--learningRate", type=float, default=0.1)
+    p.add_argument("--vocabSize", type=int, default=4000)
+    p.add_argument("--hiddenSize", type=int, default=64)
+    p.add_argument("--nHead", type=int, default=4)
+    p.add_argument("--nLayers", type=int, default=2)
+    p.add_argument("--seqLength", type=int, default=24)
+    p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each block (long-sequence memory)")
+    p.add_argument("--synthetic", action="store_true")
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset import DataSet
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.models.utils import lm_corpus, lm_sample_pipe, resolve_resume
+    from bigdl_tpu.optim import Loss, Optimizer, SGD, Trigger
+
+    Engine.init()
+    resolve_resume(args)
+    if args.synthetic or not args.folder:
+        raw = _SYNTH
+    else:
+        with open(args.folder) as f:
+            raw = f.read()
+
+    token_lists, dictionary = lm_corpus(raw, args.vocabSize)
+    if args.checkpoint:
+        from bigdl_tpu.utils import fs
+        dictionary.save(fs.join(args.checkpoint, "dictionary.json"))
+    vocab = dictionary.vocab_size()
+
+    # one_hot=False: 1-based id features (the embedding gathers; one-hot
+    # times a matrix would be the same matmul with V extra zeros)
+    pipe = lm_sample_pipe(dictionary, args.seqLength, args.batchSize,
+                          one_hot=False)
+    split = int(len(token_lists) * 0.8) or 1
+    train_ds = DataSet.array(token_lists[:split]) >> pipe
+    val_ds = DataSet.array(token_lists[split:] or token_lists[:1]) >> pipe
+
+    model = nn.Module.load(args.model) if args.model else \
+        TransformerLM(vocab, hidden_size=args.hiddenSize, n_head=args.nHead,
+                      n_layers=args.nLayers, max_len=args.seqLength,
+                      dropout=args.dropout, remat=args.remat).build(seed=1)
+    criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    method = SGD(learning_rate=args.learningRate)
+    optimizer = Optimizer.create(model, train_ds, criterion)
+    if args.state:
+        from bigdl_tpu.utils import file_io
+        snap = file_io.load(args.state)
+        optimizer.set_state(snap["driver_state"])
+        if snap.get("optim_state") is not None:
+            method._state = snap["optim_state"]
+    optimizer.set_optim_method(method) \
+             .set_end_when(Trigger.max_epoch(args.maxEpoch)) \
+             .set_validation(Trigger.every_epoch(), val_ds, [Loss(criterion)])
+    if args.checkpoint:
+        optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+    optimizer.optimize()
+
+
+if __name__ == "__main__":
+    main()

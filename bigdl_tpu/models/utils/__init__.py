@@ -1,5 +1,39 @@
 
 
+def lm_corpus(raw: str, vocab_size: int, dictionary=None):
+    """Tokenize a raw LM corpus and build (or reuse) its Dictionary —
+    the shared front half of every language-model CLI (rnn and
+    transformer Train/Test mains; ref models/rnn/Train.scala:62-90
+    readSentence + Dictionary).  Returns (token_lists, dictionary)."""
+    from bigdl_tpu.dataset import text
+
+    tokenize = text.SentenceSplitter() >> text.SentenceTokenizer() \
+        >> text.SentenceBiPadding()
+    token_lists = list(tokenize([raw]))
+    if dictionary is None:
+        dictionary = text.Dictionary(token_lists, vocab_size=vocab_size)
+    return token_lists, dictionary
+
+
+def lm_sample_pipe(dictionary, seq_length: int, batch_size: int,
+                   one_hot: bool = True):
+    """token list -> next-token Sample -> padded batch, with the pad label
+    derived from the dictionary's sentence-end token (must be identical
+    between a family's Train and Test mains — one definition here so the
+    two cannot diverge).  ``one_hot=False`` emits 1-based id features for
+    embedding models (LookupTable / TransformerLM)."""
+    from bigdl_tpu.dataset import text
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+
+    vocab = dictionary.vocab_size()
+    pad_label = dictionary.get_index(text.SENTENCE_END) + 1
+    return (text.TextToLabeledSentence(dictionary)
+            >> text.LabeledSentenceToSample(vocab, fixed_length=seq_length,
+                                            one_hot=one_hot,
+                                            pad_label=pad_label)
+            >> SampleToBatch(batch_size))
+
+
 def resolve_resume(args) -> None:
     """--resume <ckpt-dir>: point --model/--state at the directory's
     newest checkpoint pair (any fs scheme).  An empty/missing directory

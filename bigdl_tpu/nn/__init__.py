@@ -11,10 +11,10 @@ from bigdl_tpu.nn.containers import (
     SelectTable,
 )
 from bigdl_tpu.nn.activations import (
-    ReLU, ReLU6, Tanh, Sigmoid, SoftMax, SoftMin, LogSoftMax, LogSigmoid,
-    SoftPlus, SoftSign, LeakyReLU, ELU, PReLU, RReLU, HardTanh, HardShrink,
-    SoftShrink, TanhShrink, Threshold, Clamp, Power, Square, Sqrt, Log, Exp,
-    Abs,
+    ReLU, ReLU6, GELU, Tanh, Sigmoid, SoftMax, SoftMin, LogSoftMax,
+    LogSigmoid, SoftPlus, SoftSign, LeakyReLU, ELU, PReLU, RReLU, HardTanh,
+    HardShrink, SoftShrink, TanhShrink, Threshold, Clamp, Power, Square,
+    Sqrt, Log, Exp, Abs,
 )
 from bigdl_tpu.nn.linear import (
     Linear, Bilinear, MM, MV, DotProduct, Cosine, Euclidean,
@@ -27,7 +27,7 @@ from bigdl_tpu.nn.conv import (
 )
 from bigdl_tpu.nn.pooling import SpatialMaxPooling, SpatialAveragePooling, RoiPooling
 from bigdl_tpu.nn.normalization import (
-    BatchNormalization, SpatialBatchNormalization, Normalize,
+    BatchNormalization, SpatialBatchNormalization, LayerNorm, Normalize,
     SpatialCrossMapLRN, SpatialSubtractiveNormalization,
     SpatialDivisiveNormalization, SpatialContrastiveNormalization,
 )

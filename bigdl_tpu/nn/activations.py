@@ -28,6 +28,19 @@ class ReLU6(Module):
         return jnp.clip(x, 0, 6)
 
 
+class GELU(Module):
+    """Gaussian error linear unit (post-reference capability, the
+    transformer stack's activation).  ``approximate=True`` is the tanh
+    form — one less erf on the VPU, the usual TPU choice."""
+
+    def __init__(self, approximate: bool = True):
+        super().__init__()
+        self.approximate = approximate
+
+    def f(self, params, x, **kw):
+        return jax.nn.gelu(x, approximate=self.approximate)
+
+
 class Tanh(Module):
     def f(self, params, x, **kw):
         return jnp.tanh(x)

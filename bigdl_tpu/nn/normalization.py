@@ -89,6 +89,46 @@ class SpatialBatchNormalization(BatchNormalization):
     (ref nn/SpatialBatchNormalization.scala)."""
 
 
+def layer_norm(x, weight=None, bias=None, eps: float = 1e-5):
+    """Functional layer norm over the trailing dim, shared by the
+    ``LayerNorm`` module and the transformer block (models/transformer).
+    Normalizes in f32 even under bf16 compute: mean/var cancellation loses
+    bf16's 8 mantissa bits fast, and the cast pair fuses away."""
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * lax.rsqrt(var + eps)
+    if weight is not None:
+        y = y * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+class LayerNorm(Module):
+    """Layer normalization over the trailing feature dim (post-reference
+    capability: the reference's zoo predates transformers — this is the
+    normalization the transformer stack needs, sharing BatchNormalization's
+    affine gamma/beta convention but with no running stats, so it is
+    stateless and mesh-friendly: every token normalizes independently,
+    nothing crosses the data/sequence axes)."""
+
+    def __init__(self, n_output: int, eps: float = 1e-5, affine: bool = True):
+        super().__init__()
+        self.n_output = n_output
+        self.eps = eps
+        self.affine = affine
+
+    def init(self, rng):
+        if not self.affine:
+            return {}
+        return {"weight": jnp.ones((self.n_output,), jnp.float32),
+                "bias": jnp.zeros((self.n_output,), jnp.float32)}
+
+    def f(self, params, x, **kw):
+        if self.affine:
+            return layer_norm(x, params["weight"], params["bias"], self.eps)
+        return layer_norm(x, eps=self.eps)
+
+
 class Normalize(Module):
     """Lp-normalize each row (ref nn/Normalize.scala)."""
 

@@ -1,0 +1,165 @@
+"""TransformerLM family: layer oracles (LayerNorm/GELU vs torch), model
+semantics (causality, scan-depth independence, remat parity, save/load),
+end-to-end training, and the Train/Test CLI pair."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+
+
+class TestLayerOracles:
+    def test_layer_norm_matches_torch(self):
+        import torch
+        import torch.nn.functional as F
+
+        x = np.random.RandomState(0).randn(4, 7, 16).astype(np.float32)
+        ln = nn.LayerNorm(16).build(seed=3)
+        g = np.asarray(ln.params["weight"])
+        b = np.asarray(ln.params["bias"])
+        got = np.asarray(ln.f(ln.params, jnp.asarray(x)))
+        ref = F.layer_norm(torch.from_numpy(x), (16,),
+                           torch.from_numpy(g), torch.from_numpy(b),
+                           eps=1e-5).numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_layer_norm_no_affine(self):
+        x = np.random.RandomState(1).randn(5, 8).astype(np.float32)
+        ln = nn.LayerNorm(8, affine=False).build()
+        y = np.asarray(ln.f(ln.params, jnp.asarray(x)))
+        np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(y.std(-1), 1.0, atol=1e-3)
+
+    def test_gelu_matches_torch(self):
+        import torch
+        import torch.nn.functional as F
+
+        x = np.linspace(-4, 4, 101).astype(np.float32)
+        got = np.asarray(nn.GELU().f({}, jnp.asarray(x)))
+        ref = F.gelu(torch.from_numpy(x), approximate="tanh").numpy()
+        np.testing.assert_allclose(got, ref, atol=1e-5)
+        got_exact = np.asarray(nn.GELU(approximate=False).f({}, jnp.asarray(x)))
+        ref_exact = F.gelu(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(got_exact, ref_exact, atol=1e-5)
+
+
+def _ids(rng, b, t, vocab):
+    return jnp.asarray(rng.randint(1, vocab + 1, size=(b, t))
+                       .astype(np.float32))
+
+
+class TestTransformerLM:
+    def _model(self, **kw):
+        from bigdl_tpu.models import TransformerLM
+        args = dict(vocab_size=11, hidden_size=16, n_head=2, n_layers=2,
+                    max_len=12)
+        args.update(kw)
+        return TransformerLM(**args).build(seed=1)
+
+    def test_forward_shape_and_normalization(self):
+        m = self._model()
+        x = _ids(np.random.RandomState(0), 3, 10, 11)
+        y, _ = m.apply(m.params, x)
+        assert y.shape == (3, 10, 11)
+        # log-probs: exp sums to 1 per position
+        np.testing.assert_allclose(np.exp(np.asarray(y)).sum(-1), 1.0,
+                                   atol=1e-4)
+
+    def test_causality(self):
+        """Changing a future token must not change earlier outputs."""
+        m = self._model()
+        rng = np.random.RandomState(0)
+        x = np.asarray(_ids(rng, 2, 10, 11))
+        y1, _ = m.apply(m.params, jnp.asarray(x))
+        x2 = x.copy()
+        x2[:, 7:] = ((x2[:, 7:] + 1) % 11) + 1  # perturb positions 7..9
+        y2, _ = m.apply(m.params, jnp.asarray(x2))
+        np.testing.assert_allclose(np.asarray(y1)[:, :7],
+                                   np.asarray(y2)[:, :7], atol=1e-5)
+        assert not np.allclose(np.asarray(y1)[:, 7:], np.asarray(y2)[:, 7:])
+
+    def test_remat_matches_plain(self):
+        m1 = self._model(remat=False)
+        m2 = self._model(remat=True)  # same seed -> same params
+        x = _ids(np.random.RandomState(2), 2, 8, 11)
+        y1, _ = m1.apply(m1.params, x)
+        y2, _ = m2.apply(m2.params, x)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-5)
+        # and remat gradients equal plain gradients
+        def loss(m, p):
+            out, _ = m.apply(p, x)
+            return jnp.mean(out ** 2)
+        g1 = jax.grad(lambda p: loss(m1, p))(m1.params)
+        g2 = jax.grad(lambda p: loss(m2, p))(m2.params)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5)
+
+    def test_untied_head_and_dropout_rng(self):
+        m = self._model(tie_embeddings=False, dropout=0.5)
+        assert "head" in m.params
+        x = _ids(np.random.RandomState(3), 2, 6, 11)
+        y1, _ = m.apply(m.params, x, training=True,
+                        rng=jax.random.PRNGKey(0))
+        y2, _ = m.apply(m.params, x, training=True,
+                        rng=jax.random.PRNGKey(1))
+        assert not np.allclose(np.asarray(y1), np.asarray(y2))
+        # eval mode is deterministic regardless of rng
+        y3, _ = m.apply(m.params, x, rng=jax.random.PRNGKey(0))
+        y4, _ = m.apply(m.params, x, rng=jax.random.PRNGKey(1))
+        np.testing.assert_allclose(np.asarray(y3), np.asarray(y4))
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m = self._model()
+        x = _ids(np.random.RandomState(4), 2, 8, 11)
+        y1, _ = m.apply(m.params, x)
+        path = str(tmp_path / "tlm.bin")
+        m.save(path, overwrite=True)
+        m2 = nn.Module.load(path)
+        y2, _ = m2.apply(m2.params, x)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), atol=1e-6)
+
+    def test_memorizes_with_local_optimizer(self):
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.dataset.transformer import SampleToBatch
+        from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+        rng = np.random.RandomState(0)
+        vocab, t = 7, 6
+        seqs = rng.randint(1, vocab + 1, size=(8, t + 1))
+        samples = [Sample(s[:-1].astype(np.float32),
+                          s[1:].astype(np.float32)) for s in seqs]
+        ds = DataSet.array(samples) >> SampleToBatch(8, drop_last=True)
+        m = self._model(vocab_size=vocab, max_len=t, hidden_size=32)
+        crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+        opt = LocalOptimizer(m, ds, crit)
+        opt.set_optim_method(SGD(learning_rate=0.5)) \
+           .set_end_when(Trigger.max_iteration(60))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+        assert opt.state["loss"] < 1.0  # memorizes 8 fixed sequences
+
+
+class TestTransformerClis:
+    def test_train_then_test(self, tmp_path, capsys):
+        from bigdl_tpu.models.transformer import test as t_test
+        from bigdl_tpu.models.transformer import train as t_train
+
+        model_dir = tmp_path / "ckpt"
+        model_dir.mkdir()
+        t_train.main(["--synthetic", "-e", "1", "-b", "8",
+                      "--hiddenSize", "16", "--nHead", "2",
+                      "--nLayers", "1", "--seqLength", "8",
+                      "--checkpoint", str(model_dir)])
+        ckpts = sorted(model_dir.glob("model.*"),
+                       key=lambda p: int(p.name.split(".")[-1]))
+        assert ckpts, "train CLI must write a checkpoint"
+        dict_path = model_dir / "dictionary.json"
+        assert dict_path.exists()
+        t_test.main(["--model", str(ckpts[-1]), "--synthetic",
+                     "--dictionary", str(dict_path),
+                     "-b", "8", "--seqLength", "8"])
+        assert "Loss" in capsys.readouterr().out
