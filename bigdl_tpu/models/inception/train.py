@@ -102,11 +102,8 @@ def main(argv=None) -> None:
                  learning_rate_schedule=Poly(0.5, args.maxIteration))
     optimizer = Optimizer.create(model, train_ds, nn.ClassNLLCriterion())
     if args.state:
-        from bigdl_tpu.utils import file_io
-        snap = file_io.load(args.state)
-        optimizer.set_state(snap["driver_state"])
-        if snap.get("optim_state") is not None:
-            method._state = snap["optim_state"]
+        from bigdl_tpu.models.utils import restore_optim_state
+        restore_optim_state(optimizer, method, args.state)
     optimizer.set_optim_method(method) \
              .set_end_when(Trigger.max_iteration(args.maxIteration)) \
              .set_validation(Trigger.several_iteration(620), val_ds,

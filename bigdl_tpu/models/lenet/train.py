@@ -63,11 +63,8 @@ def main(argv=None) -> None:
     optimizer = Optimizer.create(model, train_ds, nn.ClassNLLCriterion())
     method = SGD(learning_rate=args.learningRate)
     if args.state:  # resume driver + optimizer state (ref Train.scala:55-68)
-        from bigdl_tpu.utils import file_io
-        snap = file_io.load(args.state)
-        optimizer.set_state(snap["driver_state"])
-        if snap.get("optim_state") is not None:
-            method._state = snap["optim_state"]
+        from bigdl_tpu.models.utils import restore_optim_state
+        restore_optim_state(optimizer, method, args.state)
     optimizer.set_optim_method(method) \
              .set_end_when(Trigger.max_epoch(args.maxEpoch)) \
              .set_validation(Trigger.every_epoch(), val_ds, [Top1Accuracy()])

@@ -25,6 +25,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-b", "--batchSize", type=int, default=32)
     p.add_argument("-e", "--maxEpoch", type=int, default=10)
     p.add_argument("-r", "--learningRate", type=float, default=0.1)
+    p.add_argument("--optim", default="sgd", choices=["sgd", "adam", "adamw"])
+    p.add_argument("--weightDecay", type=float, default=0.0)
     p.add_argument("--vocabSize", type=int, default=4000)
     p.add_argument("--hiddenSize", type=int, default=64)
     p.add_argument("--nHead", type=int, default=4)
@@ -45,7 +47,7 @@ def main(argv=None) -> None:
     from bigdl_tpu.dataset import DataSet
     from bigdl_tpu.models.transformer import TransformerLM
     from bigdl_tpu.models.utils import lm_corpus, lm_sample_pipe, resolve_resume
-    from bigdl_tpu.optim import Loss, Optimizer, SGD, Trigger
+    from bigdl_tpu.optim import Adam, AdamW, Loss, Optimizer, SGD, Trigger
 
     Engine.init()
     resolve_resume(args)
@@ -74,14 +76,12 @@ def main(argv=None) -> None:
                       n_layers=args.nLayers, max_len=args.seqLength,
                       dropout=args.dropout, remat=args.remat).build(seed=1)
     criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
-    method = SGD(learning_rate=args.learningRate)
+    method = {"sgd": SGD, "adam": Adam, "adamw": AdamW}[args.optim](
+        learning_rate=args.learningRate, weight_decay=args.weightDecay)
     optimizer = Optimizer.create(model, train_ds, criterion)
     if args.state:
-        from bigdl_tpu.utils import file_io
-        snap = file_io.load(args.state)
-        optimizer.set_state(snap["driver_state"])
-        if snap.get("optim_state") is not None:
-            method._state = snap["optim_state"]
+        from bigdl_tpu.models.utils import restore_optim_state
+        restore_optim_state(optimizer, method, args.state)
     optimizer.set_optim_method(method) \
              .set_end_when(Trigger.max_epoch(args.maxEpoch)) \
              .set_validation(Trigger.every_epoch(), val_ds, [Loss(criterion)])

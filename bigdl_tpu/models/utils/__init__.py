@@ -34,6 +34,27 @@ def lm_sample_pipe(dictionary, seq_length: int, batch_size: int,
             >> SampleToBatch(batch_size))
 
 
+def restore_optim_state(optimizer, method, state_path: str) -> None:
+    """Load a ``state.<n>`` snapshot into (optimizer, method): driver
+    state via ``set_state``, optimizer-method state into ``method._state``
+    — refusing a method-class mismatch loudly (an Adam m/v tree fed to
+    SGD would be silently dropped; the reverse KeyErrors inside the
+    jitted step).  One definition shared by every train CLI."""
+    from bigdl_tpu.utils import file_io
+
+    snap = file_io.load(state_path)
+    saved = snap.get("optim_method")
+    if saved is not None and saved != type(method).__name__:
+        raise SystemExit(
+            f"checkpoint {state_path} was written by {saved} but this run "
+            f"is configured with {type(method).__name__} — pass the "
+            f"matching optimizer flag (state trees are not "
+            f"interchangeable)")
+    optimizer.set_state(snap["driver_state"])
+    if snap.get("optim_state") is not None:
+        method._state = snap["optim_state"]
+
+
 def resolve_resume(args) -> None:
     """--resume <ckpt-dir>: point --model/--state at the directory's
     newest checkpoint pair (any fs scheme).  An empty/missing directory

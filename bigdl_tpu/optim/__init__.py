@@ -1,7 +1,8 @@
 """optim: optimization engine (ref spark/dl/.../optim/, 2,475 LoC)."""
 from bigdl_tpu.optim.optim_method import (
-    OptimMethod, SGD, Adagrad, LBFGS, LearningRateSchedule, Default, Poly,
-    Step, EpochStep, EpochDecay, EpochSchedule, Regime, ls_wolfe,
+    OptimMethod, SGD, Adagrad, Adam, AdamW, LBFGS, LearningRateSchedule,
+    Default, Poly, Step, EpochStep, EpochDecay, EpochSchedule, Regime,
+    ls_wolfe,
 )
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (

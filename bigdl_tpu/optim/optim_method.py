@@ -217,6 +217,82 @@ class Adagrad(OptimMethod):
         return new_params, {"iteration": state["iteration"] + 1, "accum": accum}
 
 
+class Adam(OptimMethod):
+    """Adam with bias correction (post-reference capability: the
+    reference's method set is SGD/Adagrad/LBFGS, optim/; the transformer
+    family effectively requires an adaptive method, and the state pytree
+    shards under the ZeRO-1 cycle exactly like SGD's momentum does).
+    Matches the standard formulation (Kingma & Ba 2015) — oracle-tested
+    against torch.optim.Adam."""
+
+    def __init__(self, learning_rate: float = 1e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8,
+                 weight_decay: float = 0.0,
+                 learning_rate_schedule: Optional[LearningRateSchedule] = None):
+        self.learning_rate = learning_rate
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.learning_rate_schedule = learning_rate_schedule or Default()
+
+    def init_state(self, params):
+        return {"iteration": jnp.zeros((), jnp.int32),
+                "m": jax.tree_util.tree_map(jnp.zeros_like, params),
+                "v": jax.tree_util.tree_map(jnp.zeros_like, params)}
+
+    def current_rate(self, state, epoch=1):
+        return self.learning_rate_schedule.rate(
+            self.learning_rate, state["iteration"], epoch)
+
+    def _decayed(self, grads, params):
+        if self.weight_decay == 0.0:
+            return grads
+        # L2-style decay folded into the gradient (torch.optim.Adam
+        # semantics; see AdamW for the decoupled variant)
+        return jax.tree_util.tree_map(
+            lambda g, w: g + self.weight_decay * w, grads, params)
+
+    def update(self, grads, state, params, epoch=1):
+        lr = self.current_rate(state, epoch)
+        t = state["iteration"] + 1
+        tf = t.astype(jnp.float32)
+        grads = self._decayed(grads, params)
+        m = jax.tree_util.tree_map(
+            lambda mm, g: self.beta1 * mm + (1 - self.beta1) * g,
+            state["m"], grads)
+        v = jax.tree_util.tree_map(
+            lambda vv, g: self.beta2 * vv + (1 - self.beta2) * g * g,
+            state["v"], grads)
+        bc1 = 1 - self.beta1 ** tf
+        bc2 = 1 - self.beta2 ** tf
+        new_params = jax.tree_util.tree_map(
+            lambda w, mm, vv: w - lr * (mm / bc1)
+            / (jnp.sqrt(vv / bc2) + self.eps),
+            params, m, v)
+        new_params = self._post_step(new_params, params, lr)
+        return new_params, {"iteration": t, "m": m, "v": v}
+
+    def _post_step(self, new_params, params, lr):
+        return new_params
+
+
+class AdamW(Adam):
+    """Adam with decoupled weight decay (Loshchilov & Hutter 2019):
+    decay applies directly to the weights, scaled by the current rate,
+    instead of riding the gradient through the second-moment estimate."""
+
+    def _decayed(self, grads, params):
+        return grads  # decay decoupled: applied in _post_step
+
+    def _post_step(self, new_params, params, lr):
+        if self.weight_decay == 0.0:
+            return new_params
+        return jax.tree_util.tree_map(
+            lambda nw, w: nw - lr * self.weight_decay * w,
+            new_params, params)
+
+
 # --------------------------------------------------------------------- #
 # LBFGS (ref optim/LBFGS.scala:38-280 + LineSearch.scala lswolfe)       #
 # --------------------------------------------------------------------- #

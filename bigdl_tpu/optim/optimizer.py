@@ -226,7 +226,11 @@ class Optimizer:
         host_state = dict(self.state)
         file_io.save({"driver_state": host_state,
                       "optim_state": jax.tree_util.tree_map(
-                          np.asarray, opt_state) if opt_state is not None else None},
+                          np.asarray, opt_state) if opt_state is not None else None,
+                      # recorded so resume can refuse a mismatched method
+                      # (an Adam m/v tree fed to SGD would be silently
+                      # dropped; the reverse KeyErrors inside the step)
+                      "optim_method": type(self.optim_method).__name__},
                      fs.join(self.checkpoint_path, f"state.{n}"), overwrite=True)
         log.info("checkpoint written at iteration %d", n)
 
