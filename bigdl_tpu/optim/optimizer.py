@@ -269,7 +269,13 @@ class LocalOptimizer(Optimizer):
         self._init_driver_state()
         self.model._built()
         params, buffers = self.model.params, self.model.buffers
-        opt_state = self.optim_method.init_state(params)
+        # a restored snapshot (restore_optim_state) takes priority over a
+        # fresh init: resume must continue Adam m/v, SGD momentum, and the
+        # iteration counter every LR schedule reads — a silent re-init
+        # would restart the schedule and re-warm the moments
+        restored = getattr(self.optim_method, "_state", None)
+        opt_state = restored if restored else \
+            self.optim_method.init_state(params)
         if isinstance(self.optim_method, LBFGS):
             return self._optimize_lbfgs()
         self._step_fn = self._build_step()

@@ -172,7 +172,12 @@ class DistriOptimizer(Optimizer):
         arp = AllReduceParameter(self.model.params, self.n_slots)
         w_shards = jnp.reshape(arp.init_shards(self.model.params), (-1,))
         w_shards = jax.device_put(w_shards, NamedSharding(self.mesh, P(DATA_AXIS)))
-        opt_state = self.optim_method.init_state(
+        # a restored snapshot continues where the checkpoint left off: the
+        # published _state is the host view of the flat padded vector(s),
+        # which re-shards over the mesh exactly like a fresh init (a
+        # changed slot count fails loudly on the shape)
+        restored = getattr(self.optim_method, "_state", None)
+        opt_state = restored if restored else self.optim_method.init_state(
             jnp.zeros((arp.padded_size,), jnp.float32))
         opt_state = jax.device_put(
             opt_state,
@@ -288,6 +293,10 @@ class DistriOptimizer(Optimizer):
         log.info("phase breakdown: %s", self.metrics.summary())
         self.model.params = arp.to_pytree(_fetch_to_host(w_shards))
         self.model.buffers = buffers
+        # publish the final optimizer state too — without this, a run that
+        # never checkpointed leaves _state at its pre-loop value and a
+        # later save/resume would rewind the moments and LR schedule
+        self.optim_method._state = _fetch_tree_to_host(opt_state)
         return self.model
 
     def collective_footprint(self) -> dict:

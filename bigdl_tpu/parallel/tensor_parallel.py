@@ -91,3 +91,40 @@ def constrain_batch(x, mesh: Mesh, axis: str = DATA_AXIS):
     """Pin the batch dim sharding inside a jitted step (activations)."""
     from bigdl_tpu.parallel.mesh import batch_sharding
     return jax.lax.with_sharding_constraint(x, batch_sharding(mesh, x.ndim, axis))
+
+
+def transformer_lm_tp_rules(mesh: Mesh, axis: str = MODEL_AXIS):
+    """Megatron sharding for ``models.transformer.TransformerLM``'s
+    layer-STACKED parameter tree (every block leaf carries a leading
+    ``n_layers`` axis for ``lax.scan``, so the Megatron dims shift right
+    by one): attention q/k/v column-parallel over heads, wo row-parallel,
+    MLP w1 column / w2 row, embeddings/norms/head replicated.  One psum
+    per attention block and one per MLP, inserted by XLA.
+
+    Use with the XLA attention path (``attention_impl="auto"``): GSPMD
+    partitions einsum attention over the sharded head dim by itself; the
+    Pallas flash kernel partitions under ``shard_map`` instead (see
+    ``bigdl_tpu.parallel.sequence`` for that composition)."""
+
+    def rules(path, leaf):
+        name = jax.tree_util.keystr(path)
+        stacked = 1 if "blocks" in name else 0
+
+        def spec(*dims):
+            return NamedSharding(mesh, P(*([None] * stacked), *dims))
+
+        if any(w in name for w in ("wq", "wk", "wv")):
+            return spec(None, axis)          # (h, inner) col-parallel
+        if any(b in name for b in ("bq", "bk", "bv")):
+            return spec(axis)
+        if "wo" in name:
+            return spec(axis, None)          # (inner, h) row-parallel
+        if "'w1'" in name:
+            return spec(None, axis)          # (h, ffn) col-parallel
+        if "'b1'" in name:
+            return spec(axis)
+        if "'w2'" in name:
+            return spec(axis, None)          # (ffn, h) row-parallel
+        return replicated_spec(mesh)
+
+    return rules

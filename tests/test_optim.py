@@ -151,15 +151,48 @@ class TestAdam:
                         if f.startswith("state."))
         assert states
         path = str(tmp_path / states[-1])
-        # matching method restores fine
+        # matching method restores fine AND the loop consumes it: the
+        # resumed run continues the step counter (3 saved + 1 new = 4)
+        # instead of silently re-initialising moments and schedule
         opt2 = LocalOptimizer(model, _toy_regression_dataset(),
                               nn.MSECriterion())
         m2 = Adam(learning_rate=0.01)
         restore_optim_state(opt2, m2, path)
         assert "m" in m2._state
+        opt2.set_optim_method(m2).set_end_when(Trigger.max_iteration(4))
+        opt2.optimize()
+        assert int(m2._state["iteration"]) == 4
         # mismatched method refuses
         with pytest.raises(SystemExit, match="Adam"):
             restore_optim_state(opt2, SGD(learning_rate=0.01), path)
+
+    def test_distri_resume_consumes_state(self, tmp_path):
+        """The mesh path re-shards a restored flat state over the slots
+        and continues the counter, same contract as the local loop."""
+        import os
+
+        from bigdl_tpu.models.utils import restore_optim_state
+        from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+        from bigdl_tpu.parallel.mesh import DATA_AXIS
+
+        mesh = create_mesh({DATA_AXIS: 4}, devices=jax.devices()[:4])
+        model = nn.Linear(2, 2, with_bias=False)
+        opt = DistriOptimizer(model, _toy_regression_dataset(),
+                              nn.MSECriterion(), mesh=mesh)
+        opt.set_optim_method(Adam(learning_rate=0.01)) \
+           .set_end_when(Trigger.max_iteration(2)) \
+           .set_checkpoint(str(tmp_path), Trigger.several_iteration(1))
+        opt.optimize()
+        states = sorted(f for f in os.listdir(tmp_path)
+                        if f.startswith("state."))
+        path = str(tmp_path / states[-1])
+        m2 = Adam(learning_rate=0.01)
+        opt2 = DistriOptimizer(model, _toy_regression_dataset(),
+                               nn.MSECriterion(), mesh=mesh)
+        restore_optim_state(opt2, m2, path)
+        opt2.set_optim_method(m2).set_end_when(Trigger.max_iteration(3))
+        opt2.optimize()
+        assert int(m2._state["iteration"]) == 3
 
     def test_distri_optimizer_sharded_adam_state(self):
         """Adam's m/v ride the ZeRO-1 cycle: per-shard slices of the flat
