@@ -1,0 +1,100 @@
+"""Sequence-parallel TransformerLM: the whole forward under ``shard_map``
+with the sequence dim sharded over a mesh axis and every attention block
+running ring attention (neighbor ppermute over ICI, online-softmax merge —
+``bigdl_tpu.parallel.sequence``).  This is the long-context composition the
+survey's §5.7 gap-fill calls for, applied to the flagship LM: activations
+never materialize the full sequence on one device, so context length
+scales with the mesh instead of with HBM.
+
+Everything except attention is token-local (LayerNorm, MLP, embedding,
+head), so the only communication is the ring itself — one neighbor
+exchange per hop, no all-gathers.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+from bigdl_tpu.parallel.sequence import ring_attention_local
+
+
+def ring_lm_apply(model: TransformerLM, params, ids, mesh: Mesh, *,
+                  seq_axis: str = SEQUENCE_AXIS,
+                  data_axis: Optional[str] = DATA_AXIS,
+                  impl: Optional[str] = None,
+                  block_size: Optional[int] = None):
+    """Sequence-parallel forward of ``model`` (a built ``TransformerLM``):
+    ids (B, T) with T divisible by the ``seq_axis`` size; returns
+    (B, T, vocab) log-probs sharded the same way the input was.
+
+    The built model's configuration is authoritative: ``impl`` defaults
+    from its ``attention_impl`` ("flash" -> the Pallas kernel inside every
+    ring hop, the TPU long-context hot path), ``block_size`` from its
+    block size, and ``model.remat`` wraps each block in ``jax.checkpoint``
+    exactly as the single-device forward does.  Training-mode dropout is
+    not supported under the ring (model.dropout must be 0).
+    """
+    if model.dropout > 0.0:
+        raise ValueError("ring_lm_apply does not support dropout — build "
+                         "the TransformerLM with dropout=0")
+    if ids.shape[-1] > model.max_len:
+        # the per-shard dynamic_slice on the position table would CLAMP an
+        # out-of-range offset and silently reuse trailing positions; fail
+        # loudly like the single-device path does
+        raise ValueError(
+            f"sequence length {ids.shape[-1]} exceeds the model's "
+            f"max_len {model.max_len}")
+    mha = model._mha
+    if impl is None:
+        impl = "flash" if mha.attention_impl == "flash" else "blocks"
+    if block_size is None:
+        block_size = mha.block_size or 128
+
+    def local_fwd(params, ids_local):
+        ids_i = jnp.asarray(ids_local)
+        if jnp.issubdtype(ids_i.dtype, jnp.floating):
+            ids_i = ids_i.astype(jnp.int32)
+        ids_i = ids_i - 1
+        t_local = ids_i.shape[-1]
+        offset = lax.axis_index(seq_axis) * t_local
+        pos = lax.dynamic_slice(params["pos"], (offset, 0),
+                                (t_local, params["pos"].shape[1]))
+        h = params["embed"][ids_i] + pos
+
+        def block(bp, h):
+            a = model._layer_norm(bp["ln1"], h)
+            q, k, v = mha.project_qkv(bp["attn"], a, a, a)
+            o = ring_attention_local(q, k, v, seq_axis, causal=True,
+                                     impl=impl, block_size=block_size)
+            h = h + mha.project_out(bp["attn"], o)
+            m = model._layer_norm(bp["ln2"], h)
+            m = jax.nn.gelu(m @ bp["w1"] + bp["b1"], approximate=True)
+            h = h + (m @ bp["w2"] + bp["b2"])
+            return h
+
+        if model.remat:
+            block = jax.checkpoint(block)
+        h, _ = lax.scan(lambda carry, bp: (block(bp, carry), None),
+                        h, params["blocks"])
+        h = model._layer_norm(params["ln_f"], h)
+        head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
+                else params["head"].astype(h.dtype))
+        logits = h @ head
+        return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    io_spec = P(data_axis, seq_axis)
+    fn = shard_map(local_fwd, mesh=mesh,
+                   in_specs=(P(), io_spec),
+                   out_specs=P(data_axis, seq_axis, None))
+    return fn(params, ids)

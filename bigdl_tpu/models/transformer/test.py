@@ -31,7 +31,7 @@ def main(argv=None) -> None:
     from bigdl_tpu import Engine, nn
     from bigdl_tpu.dataset import DataSet, text
     from bigdl_tpu.models.utils import lm_corpus, lm_sample_pipe
-    from bigdl_tpu.optim import LocalValidator, Loss
+    from bigdl_tpu.optim import LocalValidator, Loss, PerplexityResult
 
     Engine.init()
     if args.synthetic or not args.folder:
@@ -50,6 +50,9 @@ def main(argv=None) -> None:
     criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
     for method, result in LocalValidator(model, ds).test([Loss(criterion)]):
         print(f"{method} is {result}")
+        # perplexity = exp(mean loss): derived from the same accumulation
+        # instead of a second criterion pass per batch
+        print(f"Perplexity is {PerplexityResult(result.loss, result.count)}")
 
 
 if __name__ == "__main__":

@@ -35,6 +35,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (long-sequence memory)")
+    p.add_argument("--distributed", action="store_true")
     p.add_argument("--synthetic", action="store_true")
     return p
 
@@ -68,7 +69,8 @@ def main(argv=None) -> None:
     pipe = lm_sample_pipe(dictionary, args.seqLength, args.batchSize,
                           one_hot=False)
     split = int(len(token_lists) * 0.8) or 1
-    train_ds = DataSet.array(token_lists[:split]) >> pipe
+    train_ds = DataSet.array(token_lists[:split],
+                             distributed=args.distributed) >> pipe
     val_ds = DataSet.array(token_lists[split:] or token_lists[:1]) >> pipe
 
     model = nn.Module.load(args.model) if args.model else \

@@ -7,7 +7,7 @@ from bigdl_tpu.optim.optim_method import (
 from bigdl_tpu.optim.trigger import Trigger
 from bigdl_tpu.optim.validation import (
     ValidationMethod, ValidationResult, AccuracyResult, LossResult,
-    Top1Accuracy, Top5Accuracy, Loss,
+    PerplexityResult, Top1Accuracy, Top5Accuracy, Loss, Perplexity,
 )
 from bigdl_tpu.optim.metrics import Metrics
 from bigdl_tpu.optim.optimizer import (

@@ -93,3 +93,38 @@ class Loss(ValidationMethod):
 
     def __call__(self, output, target) -> LossResult:
         return LossResult(float(self.criterion.loss(output, target)), 1)
+
+
+class PerplexityResult(ValidationResult):
+    """exp of the mean criterion value — the LM family's standard metric
+    (post-reference capability alongside TransformerLM).  Accumulates the
+    loss sum so the monoid ``+`` stays exact; exp is applied at
+    ``result()``."""
+
+    def __init__(self, loss: float, count: int):
+        self.loss = float(loss)
+        self.count = int(count)
+
+    def result(self):
+        return (float(np.exp(self.loss / max(self.count, 1))), self.count)
+
+    def __add__(self, other: "PerplexityResult") -> "PerplexityResult":
+        return PerplexityResult(self.loss + other.loss,
+                                self.count + other.count)
+
+    def __repr__(self):
+        ppl, n = self.result()
+        return f"Perplexity(count: {n}, perplexity: {ppl:.4f})"
+
+
+class Perplexity(ValidationMethod):
+    """Per-batch perplexity from a (time-distributed) NLL criterion."""
+    name = "Perplexity"
+
+    def __init__(self, criterion=None):
+        from bigdl_tpu.nn.criterions import ClassNLLCriterion
+        self.criterion = (criterion if criterion is not None
+                          else ClassNLLCriterion())
+
+    def __call__(self, output, target) -> PerplexityResult:
+        return PerplexityResult(float(self.criterion.loss(output, target)), 1)

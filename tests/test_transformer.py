@@ -143,6 +143,85 @@ class TestTransformerLM:
         assert opt.state["loss"] < 1.0  # memorizes 8 fixed sequences
 
 
+class TestSequenceParallelLM:
+    def test_ring_lm_matches_local(self):
+        """Sequence-parallel forward (ring attention per block) matches
+        the single-device model, loss and grads, on a data x seq mesh."""
+        from bigdl_tpu.models import TransformerLM
+        from bigdl_tpu.models.transformer.sp import ring_lm_apply
+        from bigdl_tpu.parallel import create_mesh
+        from bigdl_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+
+        mesh = create_mesh({DATA_AXIS: 2, SEQUENCE_AXIS: 4})
+        m = TransformerLM(vocab_size=11, hidden_size=16, n_head=2,
+                          n_layers=2, max_len=16).build(seed=1)
+        ids = jnp.asarray(np.random.RandomState(0)
+                          .randint(1, 12, size=(4, 16)).astype(np.float32))
+
+        ref, _ = m.apply(m.params, ids)
+
+        @jax.jit
+        def sp_fwd(p, x):
+            return ring_lm_apply(m, p, x, mesh)
+
+        out = sp_fwd(m.params, ids)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+        def ref_loss(p):
+            y, _ = m.apply(p, ids)
+            return jnp.mean(y ** 2)
+
+        def sp_loss(p):
+            return jnp.mean(ring_lm_apply(m, p, ids, mesh) ** 2)
+
+        g_ref = jax.grad(ref_loss)(m.params)
+        g_sp = jax.jit(jax.grad(sp_loss))(m.params)
+        for a, b in zip(jax.tree_util.tree_leaves(g_sp),
+                        jax.tree_util.tree_leaves(g_ref)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=2e-4, rtol=2e-3)
+
+    def test_ring_lm_rejects_dropout_and_overlong_sequence(self):
+        from bigdl_tpu.models import TransformerLM
+        from bigdl_tpu.models.transformer.sp import ring_lm_apply
+        from bigdl_tpu.parallel import create_mesh
+        from bigdl_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+
+        mesh = create_mesh({DATA_AXIS: 2, SEQUENCE_AXIS: 4})
+        m = TransformerLM(vocab_size=5, hidden_size=8, n_head=2,
+                          n_layers=1, max_len=8, dropout=0.1).build(seed=0)
+        with pytest.raises(ValueError, match="dropout"):
+            ring_lm_apply(m, m.params, jnp.ones((2, 8)), mesh)
+        m2 = TransformerLM(vocab_size=5, hidden_size=8, n_head=2,
+                           n_layers=1, max_len=8).build(seed=0)
+        # the sharded dynamic_slice would CLAMP, silently reusing trailing
+        # positions; must fail loudly like the single-device path
+        with pytest.raises(ValueError, match="max_len"):
+            ring_lm_apply(m2, m2.params, jnp.ones((2, 16)), mesh)
+
+    def test_ring_lm_honors_model_remat(self):
+        """A remat-built model produces identical sp outputs (the block
+        is checkpointed, not changed)."""
+        from bigdl_tpu.models import TransformerLM
+        from bigdl_tpu.models.transformer.sp import ring_lm_apply
+        from bigdl_tpu.parallel import create_mesh
+        from bigdl_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
+
+        mesh = create_mesh({DATA_AXIS: 2, SEQUENCE_AXIS: 4})
+        ids = jnp.asarray(np.random.RandomState(1)
+                          .randint(1, 12, size=(2, 8)).astype(np.float32))
+        m_plain = TransformerLM(vocab_size=11, hidden_size=16, n_head=2,
+                                n_layers=2, max_len=8).build(seed=3)
+        m_remat = TransformerLM(vocab_size=11, hidden_size=16, n_head=2,
+                                n_layers=2, max_len=8,
+                                remat=True).build(seed=3)
+        y1 = ring_lm_apply(m_plain, m_plain.params, ids, mesh)
+        y2 = ring_lm_apply(m_remat, m_remat.params, ids, mesh)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                   atol=1e-5)
+
+
 class TestTransformerClis:
     def test_train_then_test(self, tmp_path, capsys):
         from bigdl_tpu.models.transformer import test as t_test
