@@ -12,7 +12,6 @@ exchange per hop, no all-gathers.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
@@ -31,12 +30,17 @@ from bigdl_tpu.parallel.sequence import ring_attention_local
 
 def ring_lm_apply(model: TransformerLM, params, ids, mesh: Mesh, *,
                   seq_axis: str = SEQUENCE_AXIS,
-                  data_axis: Optional[str] = DATA_AXIS,
+                  data_axis: Optional[str] = None,
                   impl: Optional[str] = None,
                   block_size: Optional[int] = None):
     """Sequence-parallel forward of ``model`` (a built ``TransformerLM``):
     ids (B, T) with T divisible by the ``seq_axis`` size; returns
     (B, T, vocab) log-probs sharded the same way the input was.
+
+    On a pure sequence mesh leave ``data_axis`` at None (the
+    ``ring_attention``/``ulysses`` convention); on a 2-D data x sequence
+    mesh pass it so the batch dim stays data-sharded instead of every
+    data row recomputing the full batch.
 
     The built model's configuration is authoritative: ``impl`` defaults
     from its ``attention_impl`` ("flash" -> the Pallas kernel inside every

@@ -1,0 +1,111 @@
+"""TransformerLM training-throughput harness (tokens/sec) — the LM-family
+counterpart of ``models.utils.perf`` (ref DistriOptimizerPerf's role,
+models/utils/DistriOptimizerPerf.scala:32-90, which the reference only
+ships for its conv nets).
+
+    python -m bigdl_tpu.models.utils.lm_perf -t 2048 -b 8 --flash
+    python -m bigdl_tpu.models.utils.lm_perf -t 16384 -b 1 --flash --remat
+
+Prints ONE JSON line: steady-state step time and tokens/sec for a full
+train step (forward + backward + SGD/Adam update) at the given shape,
+with the bf16-compute / f32-master recipe bench.py uses.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def run_lm_perf(seq_len: int, batch: int, *, vocab: int = 32000,
+                hidden: int = 512, heads: int = 8, layers: int = 4,
+                flash: bool = False, remat: bool = False,
+                optim: str = "adam", dtype: str = "bfloat16",
+                iters: int = 10, warmup: int = 2) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.optim import Adam, SGD
+
+    model = TransformerLM(
+        vocab_size=vocab, hidden_size=hidden, n_head=heads, n_layers=layers,
+        max_len=seq_len, remat=remat,
+        attention_impl="flash" if flash else "auto").build(seed=1)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    method = (Adam(learning_rate=1e-3) if optim == "adam"
+              else SGD(learning_rate=0.1))
+    params = model.params
+    opt_state = method.init_state(params)
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+
+    def cast(tree):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(dt) if a.dtype == jnp.float32 else a, tree)
+
+    def loss_fn(params, x, y):
+        out, _ = model.apply(cast(params), x)
+        return crit.loss(out.astype(jnp.float32), y)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads)
+        params, opt_state = method.update(grads, opt_state, params)
+        return params, opt_state, loss
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(1, vocab + 1, size=(batch, seq_len))
+                    .astype(np.float32))
+    y = jnp.asarray(rng.randint(1, vocab + 1, size=(batch, seq_len))
+                    .astype(np.float32))
+
+    loss = None
+    for _ in range(warmup):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    if loss is not None:
+        _ = float(loss)  # hard sync
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, x, y)
+    _ = float(loss)
+    dt_s = (time.perf_counter() - t0) / iters
+    return {"metric": "transformer_lm_train_step",
+            "seq_len": seq_len, "batch": batch, "vocab": vocab,
+            "hidden": hidden, "heads": heads, "layers": layers,
+            "flash": flash, "remat": remat, "optim": optim, "dtype": dtype,
+            "step_s": round(dt_s, 5),
+            "tokens_per_s": round(batch * seq_len / dt_s, 1)}
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="TransformerLM train throughput")
+    p.add_argument("-t", "--seqLen", type=int, default=2048)
+    p.add_argument("-b", "--batch", type=int, default=8)
+    p.add_argument("--vocab", type=int, default=32000)
+    p.add_argument("--hidden", type=int, default=512)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--flash", action="store_true",
+                   help="Pallas flash-attention core")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each block")
+    p.add_argument("--optim", default="adam", choices=["sgd", "adam"])
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("-i", "--iteration", type=int, default=10)
+    args = p.parse_args(argv)
+    print(json.dumps(run_lm_perf(
+        args.seqLen, args.batch, vocab=args.vocab, hidden=args.hidden,
+        heads=args.heads, layers=args.layers, flash=args.flash,
+        remat=args.remat, optim=args.optim, dtype=args.dtype,
+        iters=args.iteration)))
+
+
+if __name__ == "__main__":
+    main()

@@ -118,13 +118,17 @@ class PerplexityResult(ValidationResult):
 
 
 class Perplexity(ValidationMethod):
-    """Per-batch perplexity from a (time-distributed) NLL criterion."""
+    """Per-batch perplexity from a (time-distributed) NLL criterion.  The
+    default consumes the LM families' (B, T, V) log-prob outputs — a bare
+    ClassNLLCriterion could not (its gather clashes on the time dim)."""
     name = "Perplexity"
 
     def __init__(self, criterion=None):
-        from bigdl_tpu.nn.criterions import ClassNLLCriterion
+        from bigdl_tpu.nn.criterions import (ClassNLLCriterion,
+                                             TimeDistributedCriterion)
         self.criterion = (criterion if criterion is not None
-                          else ClassNLLCriterion())
+                          else TimeDistributedCriterion(
+                              ClassNLLCriterion(), True))
 
     def __call__(self, output, target) -> PerplexityResult:
         return PerplexityResult(float(self.criterion.loss(output, target)), 1)

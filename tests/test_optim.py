@@ -269,13 +269,15 @@ class TestValidationMethods:
 
         out = jnp.log(jnp.asarray([[0.25, 0.75], [0.5, 0.5]]))
         tgt = jnp.asarray([2.0, 1.0])
-        r = Perplexity()(out, tgt)
-        ppl, n = r.result()
         # mean NLL = -(log .75 + log .5)/2; perplexity = exp of that
         want = float(np.exp(-(np.log(0.75) + np.log(0.5)) / 2))
-        np.testing.assert_allclose(ppl, want, rtol=1e-6)
+        r = Perplexity(nn.ClassNLLCriterion())(out, tgt)
+        np.testing.assert_allclose(r.result()[0], want, rtol=1e-6)
+        # the DEFAULT consumes (B, T, V) LM outputs (time-distributed)
+        r3 = Perplexity()(out[:, None, :], tgt[:, None])
+        np.testing.assert_allclose(r3.result()[0], want, rtol=1e-6)
         # monoid: accumulating batches equals one big batch
-        r2 = Perplexity()(out, tgt) + Perplexity()(out, tgt)
+        r2 = r + Perplexity(nn.ClassNLLCriterion())(out, tgt)
         np.testing.assert_allclose(r2.result()[0], want, rtol=1e-6)
         assert r2.result()[1] == 2
 

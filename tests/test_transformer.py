@@ -162,7 +162,7 @@ class TestSequenceParallelLM:
 
         @jax.jit
         def sp_fwd(p, x):
-            return ring_lm_apply(m, p, x, mesh)
+            return ring_lm_apply(m, p, x, mesh, data_axis=DATA_AXIS)
 
         out = sp_fwd(m.params, ids)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
@@ -173,7 +173,8 @@ class TestSequenceParallelLM:
             return jnp.mean(y ** 2)
 
         def sp_loss(p):
-            return jnp.mean(ring_lm_apply(m, p, ids, mesh) ** 2)
+            return jnp.mean(ring_lm_apply(m, p, ids, mesh,
+                                           data_axis=DATA_AXIS) ** 2)
 
         g_ref = jax.grad(ref_loss)(m.params)
         g_sp = jax.jit(jax.grad(sp_loss))(m.params)
@@ -181,6 +182,24 @@ class TestSequenceParallelLM:
                         jax.tree_util.tree_leaves(g_ref)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-4, rtol=2e-3)
+
+    def test_ring_lm_pure_sequence_mesh(self):
+        """The default data_axis=None works on a mesh with ONLY a
+        sequence axis — the module's headline long-context shape."""
+        from bigdl_tpu.models import TransformerLM
+        from bigdl_tpu.models.transformer.sp import ring_lm_apply
+        from bigdl_tpu.parallel import create_mesh
+        from bigdl_tpu.parallel.mesh import SEQUENCE_AXIS
+
+        mesh = create_mesh({SEQUENCE_AXIS: 8})
+        m = TransformerLM(vocab_size=11, hidden_size=16, n_head=2,
+                          n_layers=1, max_len=16).build(seed=2)
+        ids = jnp.asarray(np.random.RandomState(5)
+                          .randint(1, 12, size=(2, 16)).astype(np.float32))
+        ref, _ = m.apply(m.params, ids)
+        out = ring_lm_apply(m, m.params, ids, mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
 
     def test_ring_lm_rejects_dropout_and_overlong_sequence(self):
         from bigdl_tpu.models import TransformerLM
@@ -216,10 +235,22 @@ class TestSequenceParallelLM:
         m_remat = TransformerLM(vocab_size=11, hidden_size=16, n_head=2,
                                 n_layers=2, max_len=8,
                                 remat=True).build(seed=3)
-        y1 = ring_lm_apply(m_plain, m_plain.params, ids, mesh)
-        y2 = ring_lm_apply(m_remat, m_remat.params, ids, mesh)
+        y1 = ring_lm_apply(m_plain, m_plain.params, ids, mesh,
+                           data_axis=DATA_AXIS)
+        y2 = ring_lm_apply(m_remat, m_remat.params, ids, mesh,
+                           data_axis=DATA_AXIS)
         np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
                                    atol=1e-5)
+
+
+class TestLmPerf:
+    def test_smoke(self):
+        from bigdl_tpu.models.utils.lm_perf import run_lm_perf
+
+        r = run_lm_perf(32, 2, vocab=50, hidden=16, heads=2, layers=1,
+                        iters=1, warmup=1)
+        assert r["tokens_per_s"] > 0
+        assert r["metric"] == "transformer_lm_train_step"
 
 
 class TestTransformerClis:
