@@ -62,12 +62,10 @@ def _run(batch: int) -> None:
     opt_state = method.init_state(params)
     rng = jax.random.PRNGKey(0)
 
-    def cast_bf16(tree):
-        return jax.tree_util.tree_map(
-            lambda a: a.astype(jnp.bfloat16) if a.dtype == jnp.float32 else a, tree)
+    from bigdl_tpu.nn._util import cast_f32_leaves
 
     def loss_fn(params_f32, buffers, x, y, rng):
-        p16 = cast_bf16(params_f32)          # bf16 compute params
+        p16 = cast_f32_leaves(params_f32, jnp.bfloat16)  # bf16 compute
         out, nb = model.apply(p16, x, buffers=buffers, training=True, rng=rng)
         return criterion.loss(out.astype(jnp.float32), y), nb
 

@@ -55,6 +55,9 @@ def main(argv=None) -> None:
              .set_end_when(Trigger.max_epoch(args.maxEpoch))
     if args.checkpoint:
         optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+        # preemptible-pod contract: SIGTERM -> final checkpoint +
+        # clean return; --resume continues on the replacement host
+        optimizer.handle_preemption()
     optimizer.optimize()
 
 

@@ -110,6 +110,9 @@ def main(argv=None) -> None:
                              [Top1Accuracy(), Top5Accuracy()])
     if args.checkpoint:
         optimizer.set_checkpoint(args.checkpoint, Trigger.several_iteration(620))
+        # preemptible-pod contract: SIGTERM -> final checkpoint +
+        # clean return; --resume continues on the replacement host
+        optimizer.handle_preemption()
     optimizer.optimize()
 
 

@@ -89,6 +89,9 @@ def main(argv=None) -> None:
              .set_validation(Trigger.every_epoch(), val_ds, [Loss(criterion)])
     if args.checkpoint:
         optimizer.set_checkpoint(args.checkpoint, Trigger.every_epoch())
+        # preemptible-pod contract: SIGTERM -> final checkpoint +
+        # clean return; --resume continues on the replacement host
+        optimizer.handle_preemption()
     optimizer.optimize()
 
 

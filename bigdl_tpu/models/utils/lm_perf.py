@@ -37,16 +37,14 @@ def run_lm_perf(seq_len: int, batch: int, *, vocab: int = 32000,
     crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
     method = (Adam(learning_rate=1e-3) if optim == "adam"
               else SGD(learning_rate=0.1))
+    from bigdl_tpu.nn._util import cast_f32_leaves
+
     params = model.params
     opt_state = method.init_state(params)
     dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
 
-    def cast(tree):
-        return jax.tree_util.tree_map(
-            lambda a: a.astype(dt) if a.dtype == jnp.float32 else a, tree)
-
     def loss_fn(params, x, y):
-        out, _ = model.apply(cast(params), x)
+        out, _ = model.apply(cast_f32_leaves(params, dt), x)
         return crit.loss(out.astype(jnp.float32), y)
 
     import functools

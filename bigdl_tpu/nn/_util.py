@@ -27,6 +27,16 @@ def fold_rng(rng, i: int):
     return None if rng is None else jax.random.fold_in(rng, i)
 
 
+def cast_f32_leaves(tree, dtype):
+    """The mixed-precision param cast (f32 leaves -> compute dtype,
+    everything else untouched) — ONE definition shared by
+    ``Optimizer.set_compute_dtype``, ``bench.py`` and the perf
+    harnesses, so the benchmarks measure exactly the recipe training
+    uses."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype) if a.dtype == jnp.float32 else a, tree)
+
+
 def match_compute_dtype(x, w):
     """AMP-style operand alignment for MXU-feeding ops: when the weight is
     a float of different precision than the float input, cast the input to

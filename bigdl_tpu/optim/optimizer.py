@@ -76,10 +76,8 @@ class Optimizer:
         # (dataset/text.py emits 1-based ids as float32).
         if self.compute_dtype is None:
             return params
-        dt = self.compute_dtype
-        return jax.tree_util.tree_map(
-            lambda a: a.astype(dt) if jnp.asarray(a).dtype == jnp.float32
-            else a, params)
+        from bigdl_tpu.nn._util import cast_f32_leaves
+        return cast_f32_leaves(params, self.compute_dtype)
 
     def _outputs_to_f32(self, out):
         """Loss inputs in f32 regardless of the compute dtype; identity in
@@ -208,10 +206,42 @@ class Optimizer:
     def _validate(self):
         raise NotImplementedError
 
-    def _maybe_checkpoint(self):
+    def _maybe_checkpoint(self) -> bool:
         if (self.checkpoint_trigger is not None and self.checkpoint_path is not None
                 and self.checkpoint_trigger(self.state)):
             self._checkpoint()
+            return True
+        return False
+
+    def handle_preemption(self, signals=None) -> "Optimizer":
+        """Graceful-preemption contract for preemptible/spot TPU pods: on
+        SIGTERM (the eviction notice), finish the in-flight iteration,
+        write a final checkpoint when a checkpoint path is configured, and
+        return from ``optimize`` cleanly so ``--resume`` continues the run
+        on the replacement machine.  This is the SPMD rendering of the
+        reference's failure-recovery story (Spark task retries,
+        SURVEY.md §5.3) — under lockstep SPMD there is no per-task retry,
+        so checkpoint-and-restart is the recovery path and the eviction
+        signal is the failure detector."""
+        import signal as _signal
+
+        if signals is None:
+            signals = (_signal.SIGTERM,)
+        self._preempted = False
+
+        def _handler(signum, frame):
+            self._preempted = True
+            log.warning("received signal %s: will checkpoint and stop "
+                        "after the current iteration", signum)
+
+        for s in signals:
+            _signal.signal(s, _handler)
+        return self
+
+    def _check_preemption(self) -> bool:
+        """True -> the loop should checkpoint (caller publishes weights
+        first where needed) and break."""
+        return bool(getattr(self, "_preempted", False))
 
     def _checkpoint(self):
         """Write model.<neval> + state.<neval> (ref Optimizer.saveModel/
@@ -327,7 +357,13 @@ class LocalOptimizer(Optimizer):
                                        iteration=it)
             self.state["neval"] += 1
             self._maybe_validate()
-            self._maybe_checkpoint()
+            wrote_ckpt = self._maybe_checkpoint()
+            if self._check_preemption():
+                if self.checkpoint_path is not None and not wrote_ckpt:
+                    self._checkpoint()
+                log.warning("stopping on preemption at iteration %d",
+                            self.state["neval"] - 1)
+                break
         self.state["records_processed"] = records_this_epoch
         log.info("training finished in %.1fs", time.perf_counter() - wall0)
         log.info("phase breakdown: %s", self.metrics.summary())

@@ -282,12 +282,20 @@ class DistriOptimizer(Optimizer):
             do_ckpt = (self.checkpoint_trigger is not None
                        and self.checkpoint_path is not None
                        and self.checkpoint_trigger(self.state))
-            if do_val or do_ckpt:
+            preempted = self._check_preemption()
+            preempt_ckpt = preempted and self.checkpoint_path is not None
+            if do_val or do_ckpt or preempt_ckpt:
+                # with no checkpoint path, preemption skips the publish —
+                # the post-loop host fetch does that work once
                 publish()
                 if do_val:
                     self._run_validation()
-                if do_ckpt:
+                if do_ckpt or preempt_ckpt:
                     self._checkpoint()
+            if preempted:
+                log.warning("stopping on preemption at iteration %d",
+                            self.state["neval"] - 1)
+                break
         self.state["records_processed"] = records_this_epoch
         log.info("training finished in %.1fs", time.perf_counter() - wall0)
         log.info("phase breakdown: %s", self.metrics.summary())
