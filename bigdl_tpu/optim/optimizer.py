@@ -87,6 +87,45 @@ class Optimizer:
         return jax.tree_util.tree_map(
             lambda o: jnp.asarray(o).astype(jnp.float32), out)
 
+    def set_constant_gradient_clipping(self, min_value: float,
+                                       max_value: float) -> "Optimizer":
+        """Clip every gradient element into [min, max] inside the jitted
+        step (the elementwise clipping later reference versions pair with
+        the norm clip below; jnp.clip fuses into the update)."""
+        assert min_value < max_value
+        self._clip_const = (float(min_value), float(max_value))
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm: float) -> "Optimizer":
+        """Scale the WHOLE gradient tree so its global L2 norm is at most
+        ``clip_norm`` (torch clip_grad_norm_ semantics — one norm across
+        all leaves, not per-leaf).  Applied after any constant clip,
+        before the optimizer update; in the distributed path it runs on
+        each device's reduce-scattered shard with a psum'd global norm."""
+        self._clip_l2 = float(clip_norm)
+        return self
+
+    def _clip_gradients(self, grads, psum_axis: Optional[str] = None):
+        """Pure, jit-composable; ``psum_axis`` makes the L2 norm global
+        across a mesh axis when grads are sharded slices."""
+        const = getattr(self, "_clip_const", None)
+        l2 = getattr(self, "_clip_l2", None)
+        if const is not None:
+            lo, hi = const
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, lo, hi), grads)
+        if l2 is not None:
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                     for g in jax.tree_util.tree_leaves(grads))
+            if psum_axis is not None:
+                from jax import lax
+                sq = lax.psum(sq, psum_axis)
+            norm = jnp.sqrt(sq)
+            scale = jnp.minimum(1.0, l2 / jnp.maximum(norm, 1e-12))
+            grads = jax.tree_util.tree_map(
+                lambda g: (g * scale).astype(g.dtype), grads)
+        return grads
+
     def set_end_when(self, trigger: Trigger) -> "Optimizer":
         self.end_when = trigger
         return self
@@ -289,6 +328,7 @@ class LocalOptimizer(Optimizer):
         def step(params, buffers, opt_state, data, labels, rng, epoch):
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, buffers, data, labels, rng)
+            grads = self._clip_gradients(grads)
             new_params, new_opt_state = method.update(grads, opt_state, params,
                                                       epoch=epoch)
             return new_params, new_buffers, new_opt_state, loss
@@ -391,7 +431,9 @@ class LocalOptimizer(Optimizer):
 
         def feval(flat):
             v, g = val_and_grad(flat)
-            return float(v), g
+            # configured clipping applies here too (the flat vector is a
+            # valid pytree for both the const and global-L2 clip)
+            return float(v), self._clip_gradients(g)
 
         flat = flat0
         dataset_size = self.dataset.size()
@@ -410,7 +452,13 @@ class LocalOptimizer(Optimizer):
                 records_this_epoch = 0
             model.params = unravel(flat)
             self._maybe_validate()
-            self._maybe_checkpoint()
+            wrote_ckpt = self._maybe_checkpoint()
+            if self._check_preemption():
+                if self.checkpoint_path is not None and not wrote_ckpt:
+                    self._checkpoint()
+                log.warning("stopping on preemption at iteration %d",
+                            self.state["neval"] - 1)
+                break
         return model
 
     def _validate(self):

@@ -118,6 +118,10 @@ class DistriOptimizer(Optimizer):
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params, buffers, data, labels, rng)
             g_shard = arp.scatter_gradients(grads, mean=True)  # bf16 reduce-scatter
+            # clip on the sharded slice with a psum'd global norm — the
+            # SPMD form of clip-then-update (each slot owns 1/N of the
+            # flat vector, so the squared-norm sum needs one scalar psum)
+            g_shard = self._clip_gradients(g_shard, psum_axis=DATA_AXIS)
             new_w, new_opt = method.update(g_shard, opt_state, w_shard, epoch=epoch)
             new_buffers = jax.tree_util.tree_map(
                 lambda b: lax.pmean(b, DATA_AXIS) if jnp.asarray(b).ndim > 0
@@ -283,6 +287,18 @@ class DistriOptimizer(Optimizer):
                        and self.checkpoint_path is not None
                        and self.checkpoint_trigger(self.state))
             preempted = self._check_preemption()
+            if (getattr(self, "_preempted", None) is not None
+                    and jax.process_count() > 1):
+                # SIGTERM lands on ONE process; an unsynchronized flag
+                # would have the evicted host enter publish()'s gather
+                # while the others enter the next step's collectives —
+                # mismatched programs, deadlock until SIGKILL.  Agree on
+                # the flag every iteration (only when handle_preemption
+                # is active, so the extra host sync is opt-in).
+                from jax.experimental import multihost_utils
+                preempted = bool(np.asarray(
+                    multihost_utils.process_allgather(
+                        np.asarray(preempted))).any())
             preempt_ckpt = preempted and self.checkpoint_path is not None
             if do_val or do_ckpt or preempt_ckpt:
                 # with no checkpoint path, preemption skips the publish —

@@ -361,6 +361,56 @@ class TestLocalOptimizer:
         assert opt.state["neval"] == 3 * 2 + 1
 
 
+class TestGradientClipping:
+    def _opt(self):
+        return LocalOptimizer(nn.Linear(2, 2, with_bias=False),
+                              _toy_regression_dataset(), nn.MSECriterion())
+
+    def test_l2_norm_matches_torch(self):
+        import torch
+
+        tree = {"a": jnp.asarray([3.0, 4.0]), "b": jnp.asarray([[12.0]])}
+        opt = self._opt().set_gradient_clipping_by_l2_norm(6.5)
+        clipped = opt._clip_gradients(tree)
+        ta = torch.tensor([3.0, 4.0], requires_grad=True)
+        tb = torch.tensor([[12.0]], requires_grad=True)
+        ta.grad, tb.grad = torch.tensor([3.0, 4.0]), torch.tensor([[12.0]])
+        torch.nn.utils.clip_grad_norm_([ta, tb], 6.5)
+        np.testing.assert_allclose(np.asarray(clipped["a"]),
+                                   ta.grad.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(clipped["b"]),
+                                   tb.grad.numpy(), rtol=1e-6)
+        # norm below the limit: untouched
+        small = opt._clip_gradients({"a": jnp.asarray([0.3, 0.4])})
+        np.testing.assert_allclose(np.asarray(small["a"]), [0.3, 0.4],
+                                   rtol=1e-6)
+
+    def test_constant_clip(self):
+        opt = self._opt().set_constant_gradient_clipping(-1.0, 1.0)
+        g = opt._clip_gradients({"w": jnp.asarray([-5.0, 0.5, 7.0])})
+        np.testing.assert_allclose(np.asarray(g["w"]), [-1.0, 0.5, 1.0])
+
+    def test_distri_l2_clip_matches_local(self):
+        """The sharded clip (per-slot slice + psum'd global norm) must
+        train identically to the local whole-tree clip."""
+        from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+        from bigdl_tpu.parallel.mesh import DATA_AXIS
+
+        def train(cls, **kw):
+            model = nn.Linear(2, 2, with_bias=False)
+            opt = cls(model, _toy_regression_dataset(), nn.MSECriterion(),
+                      **kw)
+            opt.set_optim_method(SGD(learning_rate=0.1)) \
+               .set_end_when(Trigger.max_iteration(5)) \
+               .set_gradient_clipping_by_l2_norm(0.05)  # low: always active
+            return np.asarray(opt.optimize().params["weight"])
+
+        mesh = create_mesh({DATA_AXIS: 4}, devices=jax.devices()[:4])
+        w_local = train(LocalOptimizer)
+        w_distri = train(DistriOptimizer, mesh=mesh)
+        np.testing.assert_allclose(w_distri, w_local, atol=1e-4)
+
+
 class TestPreemption:
     """handle_preemption: SIGTERM -> finish the iteration, checkpoint,
     return cleanly (the preemptible-pod recovery story, SURVEY.md §5.3)."""
@@ -406,6 +456,27 @@ class TestPreemption:
             str(tmp_path / sorted(states,
                                   key=lambda f: int(f.split(".")[1]))[-1]))
         assert opt2.state["neval"] == opt.state["neval"]
+
+    def test_lbfgs_sigterm_checkpoints_and_stops(self, tmp_path):
+        """The LBFGS host loop honors the same preemption contract (and
+        its feval applies any configured gradient clipping)."""
+        import os
+        import signal
+        import threading
+
+        model = nn.Linear(2, 2, with_bias=False)
+        opt = LocalOptimizer(model, _toy_regression_dataset(),
+                             nn.MSECriterion())
+        opt.set_optim_method(LBFGS(max_iter=5)) \
+           .set_end_when(Trigger.max_iteration(100000)) \
+           .set_checkpoint(str(tmp_path), Trigger.several_iteration(10 ** 9)) \
+           .set_gradient_clipping_by_l2_norm(1.0) \
+           .handle_preemption()
+        threading.Timer(1.0, lambda: os.kill(os.getpid(),
+                                             signal.SIGTERM)).start()
+        opt.optimize()
+        assert opt.state["neval"] < 100000
+        assert any(f.startswith("state.") for f in os.listdir(tmp_path))
 
     def test_distri_sigterm_checkpoints_and_stops(self, tmp_path):
         import os
