@@ -1,0 +1,131 @@
+"""Long-context training example: one TransformerLM, three scaling
+regimes from the same model definition (post-reference capability — the
+reference's example set stops at image/text classification; this shows the
+long-sequence story SURVEY.md §5.7 calls first-class).
+
+    # 1. single chip, flash attention + remat (the HBM-bound regime)
+    python -m bigdl_tpu.example.long_context_lm --seqLength 4096 --flash --remat
+
+    # 2. sequence-parallel over a mesh axis (ring attention)
+    python -m bigdl_tpu.example.long_context_lm --seqLength 4096 --sp 4
+
+    # 3. same, Ulysses all-to-all instead of the ring
+    python -m bigdl_tpu.example.long_context_lm --seqLength 4096 --sp 4 --ulysses
+
+Runs a few training steps on synthetic token streams and prints the
+per-step time and tokens/sec, so the three regimes are directly
+comparable on the same hardware.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="Long-context LM training demo")
+    p.add_argument("-t", "--seqLength", type=int, default=4096)
+    p.add_argument("-b", "--batchSize", type=int, default=2)
+    p.add_argument("--vocabSize", type=int, default=8192)
+    p.add_argument("--hiddenSize", type=int, default=256)
+    p.add_argument("--nHead", type=int, default=8)
+    p.add_argument("--nLayers", type=int, default=4)
+    p.add_argument("--flash", action="store_true",
+                   help="Pallas flash-attention core")
+    p.add_argument("--remat", action="store_true",
+                   help="jax.checkpoint each block")
+    p.add_argument("--sp", type=int, default=0,
+                   help="shard the sequence over this many devices "
+                        "(virtual CPU devices are created when the host "
+                        "has fewer)")
+    p.add_argument("--ulysses", action="store_true",
+                   help="all-to-all sequence parallelism instead of ring")
+    p.add_argument("-i", "--iteration", type=int, default=5)
+    return p
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    if args.sp:
+        # must run before any other jax use in the process
+        from bigdl_tpu.utils.engine import ensure_virtual_devices
+        devices = ensure_virtual_devices(args.sp)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bigdl_tpu import Engine, nn
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.optim import Adam
+
+    Engine.init()
+    model = TransformerLM(
+        vocab_size=args.vocabSize, hidden_size=args.hiddenSize,
+        n_head=args.nHead, n_layers=args.nLayers, max_len=args.seqLength,
+        remat=args.remat,
+        attention_impl="flash" if args.flash else "auto").build(seed=1)
+    crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    method = Adam(learning_rate=1e-3)
+    params = model.params
+    opt_state = method.init_state(params)
+
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(1, args.vocabSize + 1,
+                                  size=(args.batchSize, args.seqLength))
+                      .astype(np.float32))
+    labels = jnp.asarray(rng.randint(1, args.vocabSize + 1,
+                                     size=(args.batchSize, args.seqLength))
+                         .astype(np.float32))
+
+    if args.sp:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from bigdl_tpu.models.transformer.sp import (ring_lm_apply,
+                                                     ulysses_lm_apply)
+        from bigdl_tpu.parallel import create_mesh
+        from bigdl_tpu.parallel.mesh import SEQUENCE_AXIS
+
+        mesh = create_mesh({SEQUENCE_AXIS: args.sp},
+                           devices=devices[:args.sp])
+        sp_apply = ulysses_lm_apply if args.ulysses else ring_lm_apply
+        ids = jax.device_put(ids, NamedSharding(mesh, P(None, SEQUENCE_AXIS)))
+
+        def forward(p, x):
+            return sp_apply(model, p, x, mesh)
+        mode = f"sp={args.sp} ({'ulysses' if args.ulysses else 'ring'})"
+    else:
+        def forward(p, x):
+            out, _ = model.apply(p, x)
+            return out
+        mode = "single-device"
+
+    def loss_fn(p, x, y):
+        return crit.loss(forward(p, x), y)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(p, s, x, y):
+        loss, g = jax.value_and_grad(loss_fn)(p, x, y)
+        p, s = method.update(g, s, p)
+        return p, s, loss
+
+    params, opt_state, loss = step(params, opt_state, ids, labels)
+    _ = float(loss)  # compile + sync
+    t0 = time.perf_counter()
+    for _i in range(args.iteration):
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+    final = float(loss)
+    dt = (time.perf_counter() - t0) / args.iteration
+    tokens = args.batchSize * args.seqLength
+    print(f"[{mode}] T={args.seqLength} flash={args.flash} "
+          f"remat={args.remat}: {dt * 1000:.1f} ms/step, "
+          f"{tokens / dt:,.0f} tokens/s, loss {final:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -25,7 +25,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from bigdl_tpu.models.transformer import TransformerLM
 from bigdl_tpu.parallel.mesh import DATA_AXIS, SEQUENCE_AXIS
-from bigdl_tpu.parallel.sequence import ring_attention_local
+from bigdl_tpu.parallel.sequence import (ring_attention_local,
+                                         ulysses_attention_local)
 
 
 def ring_lm_apply(model: TransformerLM, params, ids, mesh: Mesh, *,
@@ -49,9 +50,55 @@ def ring_lm_apply(model: TransformerLM, params, ids, mesh: Mesh, *,
     exactly as the single-device forward does.  Training-mode dropout is
     not supported under the ring (model.dropout must be 0).
     """
+    mha = model._mha
+    if impl is None:
+        impl = "flash" if mha.attention_impl == "flash" else "blocks"
+    if block_size is None:
+        block_size = mha.block_size or 128
+
+    def attn(q, k, v):
+        return ring_attention_local(q, k, v, seq_axis, causal=True,
+                                    impl=impl, block_size=block_size)
+
+    return _sequence_parallel_apply(model, params, ids, mesh,
+                                    seq_axis=seq_axis, data_axis=data_axis,
+                                    attn_fn=attn)
+
+
+def ulysses_lm_apply(model: TransformerLM, params, ids, mesh: Mesh, *,
+                     seq_axis: str = SEQUENCE_AXIS,
+                     data_axis: Optional[str] = None):
+    """Ulysses variant of :func:`ring_lm_apply`: each attention block
+    exchanges sequence shards for head shards (one ``all_to_all`` in, one
+    out), runs full-sequence attention on ``n_head / axis_size`` heads,
+    and every other sublayer stays token-local.  Prefer the ring when the
+    sequence axis exceeds the head count; Ulysses moves less total data
+    per block when heads divide evenly (two all-to-alls vs N-1 ppermute
+    hops)."""
+    axis_size = mesh.shape[seq_axis]
+    if model.n_head % axis_size != 0:
+        raise ValueError(
+            f"Ulysses needs n_head ({model.n_head}) divisible by the "
+            f"'{seq_axis}' axis size ({axis_size}); use ring_lm_apply "
+            f"otherwise")
+
+    def attn(q, k, v):
+        return ulysses_attention_local(q, k, v, seq_axis, causal=True)
+
+    return _sequence_parallel_apply(model, params, ids, mesh,
+                                    seq_axis=seq_axis, data_axis=data_axis,
+                                    attn_fn=attn)
+
+
+def _sequence_parallel_apply(model, params, ids, mesh, *, seq_axis,
+                             data_axis, attn_fn):
+    """Shared shard_map body: embedding + per-shard positions, scan over
+    layer-stacked blocks with ``attn_fn`` as the (sequence-sharded)
+    attention core, token-local LN/MLP/head.  Validation shared by both
+    entry points lives here so the two cannot drift."""
     if model.dropout > 0.0:
-        raise ValueError("ring_lm_apply does not support dropout — build "
-                         "the TransformerLM with dropout=0")
+        raise ValueError("sequence-parallel apply does not support "
+                         "dropout — build the TransformerLM with dropout=0")
     if ids.shape[-1] > model.max_len:
         # the per-shard dynamic_slice on the position table would CLAMP an
         # out-of-range offset and silently reuse trailing positions; fail
@@ -60,10 +107,6 @@ def ring_lm_apply(model: TransformerLM, params, ids, mesh: Mesh, *,
             f"sequence length {ids.shape[-1]} exceeds the model's "
             f"max_len {model.max_len}")
     mha = model._mha
-    if impl is None:
-        impl = "flash" if mha.attention_impl == "flash" else "blocks"
-    if block_size is None:
-        block_size = mha.block_size or 128
 
     def local_fwd(params, ids_local):
         ids_i = jnp.asarray(ids_local)
@@ -79,8 +122,7 @@ def ring_lm_apply(model: TransformerLM, params, ids, mesh: Mesh, *,
         def block(bp, h):
             a = model._layer_norm(bp["ln1"], h)
             q, k, v = mha.project_qkv(bp["attn"], a, a, a)
-            o = ring_attention_local(q, k, v, seq_axis, causal=True,
-                                     impl=impl, block_size=block_size)
+            o = attn_fn(q, k, v)
             h = h + mha.project_out(bp["attn"], o)
             m = model._layer_norm(bp["ln2"], h)
             m = jax.nn.gelu(m @ bp["w1"] + bp["b1"], approximate=True)

@@ -429,11 +429,20 @@ class LocalOptimizer(Optimizer):
                 return criterion.loss(self._outputs_to_f32(out), labels)
             return jax.value_and_grad(loss_fn)(flat)
 
+        if (getattr(self, "_clip_const", None) is not None
+                or getattr(self, "_clip_l2", None) is not None):
+            # a clipped gradient is inconsistent with the loss the Wolfe
+            # line search evaluates (Armijo/curvature tests use g·d) and
+            # corrupts the y = g_new - g_prev curvature pairs; refusing
+            # loudly beats silently degrading the inverse-Hessian
+            raise ValueError(
+                "gradient clipping is incompatible with LBFGS (the line "
+                "search and curvature pairs need the true gradient) — "
+                "remove the clipping or use SGD/Adam")
+
         def feval(flat):
             v, g = val_and_grad(flat)
-            # configured clipping applies here too (the flat vector is a
-            # valid pytree for both the const and global-L2 clip)
-            return float(v), self._clip_gradients(g)
+            return float(v), g
 
         flat = flat0
         dataset_size = self.dataset.size()

@@ -149,6 +149,23 @@ class DistriOptimizer(Optimizer):
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
+    def _check_preemption(self) -> bool:
+        """Multi-host preemption consensus: SIGTERM lands on ONE process;
+        an unsynchronized flag would have the evicted host enter
+        publish()'s gather while the others enter the next step's
+        collectives — mismatched programs, deadlock until SIGKILL.  Agree
+        on the flag every iteration (only when handle_preemption is
+        active, so the extra host sync is opt-in; the startup symmetry
+        check guarantees every process participates)."""
+        preempted = super()._check_preemption()
+        if (getattr(self, "_preempted", None) is not None
+                and jax.process_count() > 1):
+            from jax.experimental import multihost_utils
+            preempted = bool(np.asarray(
+                multihost_utils.process_allgather(
+                    np.asarray(preempted))).any())
+        return preempted
+
     # ------------------------------------------------------------------ #
     def optimize(self) -> Module:
         self._init_driver_state()
@@ -163,12 +180,15 @@ class DistriOptimizer(Optimizer):
                  self.validation_trigger is not None
                  and self.validation_dataset is not None,
                  self.checkpoint_trigger is not None
-                 and self.checkpoint_path is not None], np.int32)
+                 and self.checkpoint_path is not None,
+                 # handle_preemption adds a per-iteration allgather; a
+                 # host without it would skip that collective
+                 getattr(self, "_preempted", None) is not None], np.int32)
             ref = multihost_utils.broadcast_one_to_all(cfg)
             if not np.array_equal(cfg, ref):
                 raise ValueError(
-                    "summary/validation/checkpoint configuration differs "
-                    "across processes (this host: "
+                    "summary/validation/checkpoint/preemption configuration "
+                    "differs across processes (this host: "
                     f"{cfg.tolist()}, process 0: {ref.tolist()}); "
                     "asymmetric triggers deadlock the publish collective — "
                     "configure every process identically")
@@ -287,18 +307,6 @@ class DistriOptimizer(Optimizer):
                        and self.checkpoint_path is not None
                        and self.checkpoint_trigger(self.state))
             preempted = self._check_preemption()
-            if (getattr(self, "_preempted", None) is not None
-                    and jax.process_count() > 1):
-                # SIGTERM lands on ONE process; an unsynchronized flag
-                # would have the evicted host enter publish()'s gather
-                # while the others enter the next step's collectives —
-                # mismatched programs, deadlock until SIGKILL.  Agree on
-                # the flag every iteration (only when handle_preemption
-                # is active, so the extra host sync is opt-in).
-                from jax.experimental import multihost_utils
-                preempted = bool(np.asarray(
-                    multihost_utils.process_allgather(
-                        np.asarray(preempted))).any())
             preempt_ckpt = preempted and self.checkpoint_path is not None
             if do_val or do_ckpt or preempt_ckpt:
                 # with no checkpoint path, preemption skips the publish —

@@ -458,8 +458,7 @@ class TestPreemption:
         assert opt2.state["neval"] == opt.state["neval"]
 
     def test_lbfgs_sigterm_checkpoints_and_stops(self, tmp_path):
-        """The LBFGS host loop honors the same preemption contract (and
-        its feval applies any configured gradient clipping)."""
+        """The LBFGS host loop honors the same preemption contract."""
         import os
         import signal
         import threading
@@ -470,13 +469,23 @@ class TestPreemption:
         opt.set_optim_method(LBFGS(max_iter=5)) \
            .set_end_when(Trigger.max_iteration(100000)) \
            .set_checkpoint(str(tmp_path), Trigger.several_iteration(10 ** 9)) \
-           .set_gradient_clipping_by_l2_norm(1.0) \
            .handle_preemption()
         threading.Timer(1.0, lambda: os.kill(os.getpid(),
                                              signal.SIGTERM)).start()
         opt.optimize()
         assert opt.state["neval"] < 100000
         assert any(f.startswith("state.") for f in os.listdir(tmp_path))
+
+    def test_lbfgs_refuses_gradient_clipping(self):
+        """Clipped gradients are inconsistent with the Wolfe line search
+        and curvature pairs — LBFGS must refuse loudly, not degrade."""
+        opt = LocalOptimizer(nn.Linear(2, 2, with_bias=False),
+                             _toy_regression_dataset(), nn.MSECriterion())
+        opt.set_optim_method(LBFGS(max_iter=2)) \
+           .set_end_when(Trigger.max_iteration(1)) \
+           .set_gradient_clipping_by_l2_norm(1.0)
+        with pytest.raises(ValueError, match="LBFGS"):
+            opt.optimize()
 
     def test_distri_sigterm_checkpoints_and_stops(self, tmp_path):
         import os

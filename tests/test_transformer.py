@@ -201,6 +201,28 @@ class TestSequenceParallelLM:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
 
+    def test_ulysses_lm_matches_local(self):
+        """The all-to-all variant matches too, and refuses head counts
+        the axis cannot divide."""
+        from bigdl_tpu.models import TransformerLM
+        from bigdl_tpu.models.transformer.sp import ulysses_lm_apply
+        from bigdl_tpu.parallel import create_mesh
+        from bigdl_tpu.parallel.mesh import SEQUENCE_AXIS
+
+        mesh = create_mesh({SEQUENCE_AXIS: 4}, devices=jax.devices()[:4])
+        m = TransformerLM(vocab_size=11, hidden_size=16, n_head=4,
+                          n_layers=2, max_len=16).build(seed=1)
+        ids = jnp.asarray(np.random.RandomState(0)
+                          .randint(1, 12, size=(2, 16)).astype(np.float32))
+        ref, _ = m.apply(m.params, ids)
+        out = ulysses_lm_apply(m, m.params, ids, mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+        m2 = TransformerLM(vocab_size=11, hidden_size=18, n_head=3,
+                           n_layers=1, max_len=16).build(seed=0)
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_lm_apply(m2, m2.params, ids, mesh)
+
     def test_ring_lm_rejects_dropout_and_overlong_sequence(self):
         from bigdl_tpu.models import TransformerLM
         from bigdl_tpu.models.transformer.sp import ring_lm_apply
