@@ -113,6 +113,42 @@ class TextToLabeledSentence(Transformer):
         return LabeledSentence(ids[:-1], ids[1:])
 
 
+class DocumentPacker(Transformer):
+    """Concatenate token streams and emit fixed-length next-token windows
+    (post-reference capability: the sentence-level pipeline pads every
+    sentence to ``seq_length``, which at long context wastes most of each
+    window on padding.  Packing is the standard long-context LM data prep:
+    documents are joined into one id stream — each still bi-padded with
+    its own start/end markers upstream — and the stream is cut into
+    dense (ids[:T], ids[1:T+1]) windows with no padding at all; only the
+    final partial window is dropped).
+
+    Consumes token lists, yields LabeledSentence windows; feed into
+    ``LabeledSentenceToSample(one_hot=False, fixed_length=seq_length)``
+    (every window is already exactly ``seq_length`` long).
+    """
+
+    def __init__(self, dictionary: Dictionary, seq_length: int,
+                 stride: Optional[int] = None):
+        self.dictionary = dictionary
+        self.seq_length = int(seq_length)
+        # stride < seq_length gives overlapping windows (more samples
+        # from a small corpus); default non-overlapping
+        self.stride = int(stride) if stride is not None else int(seq_length)
+        assert self.stride >= 1
+
+    def __call__(self, it: Iterator[list]) -> Iterator[LabeledSentence]:
+        buf: list = []
+        t = self.seq_length
+        for tokens in it:
+            buf.extend(self.dictionary.get_index(tok) for tok in tokens)
+            # windows need t+1 ids (input t, target shifted by one)
+            while len(buf) >= t + 1:
+                ids = np.asarray(buf[:t + 1], dtype=np.float32)
+                yield LabeledSentence(ids[:-1], ids[1:])
+                del buf[:self.stride]
+
+
 class LabeledSentenceToSample(Transformer):
     """LabeledSentence -> Sample, one-hot features and 1-based labels
     (ref text/LabeledSentenceToSample.scala).  Pads/truncates to

@@ -24,13 +24,16 @@ def main(argv=None) -> None:
     p.add_argument("-b", "--batchSize", type=int, default=32)
     p.add_argument("--vocabSize", type=int, default=4000)
     p.add_argument("--seqLength", type=int, default=24)
+    p.add_argument("--packed", action="store_true",
+                   help="evaluate on dense packed windows — must match "
+                        "how the model was trained")
     p.add_argument("--synthetic", action="store_true")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
     from bigdl_tpu import Engine, nn
-    from bigdl_tpu.dataset import DataSet, text
-    from bigdl_tpu.models.utils import lm_corpus, lm_sample_pipe
+    from bigdl_tpu.dataset import text
+    from bigdl_tpu.models.utils import lm_corpus, lm_dataset
     from bigdl_tpu.optim import LocalValidator, Loss, PerplexityResult
 
     Engine.init()
@@ -43,8 +46,8 @@ def main(argv=None) -> None:
     loaded = text.Dictionary.load(args.dictionary) if args.dictionary else None
     token_lists, dictionary = lm_corpus(raw, args.vocabSize,
                                         dictionary=loaded)
-    ds = DataSet.array(token_lists) >> lm_sample_pipe(
-        dictionary, args.seqLength, args.batchSize, one_hot=False)
+    ds = lm_dataset(token_lists, dictionary, args.seqLength, args.batchSize,
+                    packed=args.packed)
 
     model = nn.Module.load(args.model)
     criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)

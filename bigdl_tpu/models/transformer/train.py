@@ -35,6 +35,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dropout", type=float, default=0.0)
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (long-sequence memory)")
+    p.add_argument("--packed", action="store_true",
+                   help="pack documents into dense fixed-length windows "
+                        "instead of padding each sentence")
     p.add_argument("--distributed", action="store_true")
     p.add_argument("--synthetic", action="store_true")
     return p
@@ -45,9 +48,8 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO)
 
     from bigdl_tpu import Engine, nn
-    from bigdl_tpu.dataset import DataSet
     from bigdl_tpu.models.transformer import TransformerLM
-    from bigdl_tpu.models.utils import lm_corpus, lm_sample_pipe, resolve_resume
+    from bigdl_tpu.models.utils import lm_corpus, lm_dataset, resolve_resume
     from bigdl_tpu.optim import Adam, AdamW, Loss, Optimizer, SGD, Trigger
 
     Engine.init()
@@ -66,12 +68,12 @@ def main(argv=None) -> None:
 
     # one_hot=False: 1-based id features (the embedding gathers; one-hot
     # times a matrix would be the same matmul with V extra zeros)
-    pipe = lm_sample_pipe(dictionary, args.seqLength, args.batchSize,
-                          one_hot=False)
     split = int(len(token_lists) * 0.8) or 1
-    train_ds = DataSet.array(token_lists[:split],
-                             distributed=args.distributed) >> pipe
-    val_ds = DataSet.array(token_lists[split:] or token_lists[:1]) >> pipe
+    train_ds = lm_dataset(token_lists[:split], dictionary, args.seqLength,
+                          args.batchSize, packed=args.packed,
+                          distributed=args.distributed)
+    val_ds = lm_dataset(token_lists[split:] or token_lists[:1], dictionary,
+                        args.seqLength, args.batchSize, packed=args.packed)
 
     model = nn.Module.load(args.model) if args.model else \
         TransformerLM(vocab, hidden_size=args.hiddenSize, n_head=args.nHead,

@@ -21,7 +21,10 @@ def lm_sample_pipe(dictionary, seq_length: int, batch_size: int,
     derived from the dictionary's sentence-end token (must be identical
     between a family's Train and Test mains — one definition here so the
     two cannot diverge).  ``one_hot=False`` emits 1-based id features for
-    embedding models (LookupTable / TransformerLM)."""
+    embedding models (LookupTable / TransformerLM).  For dense packed
+    windows use :func:`lm_dataset` with ``packed=True`` (packing changes
+    the record count, so it must materialize eagerly for epoch
+    accounting)."""
     from bigdl_tpu.dataset import text
     from bigdl_tpu.dataset.transformer import SampleToBatch
 
@@ -32,6 +35,43 @@ def lm_sample_pipe(dictionary, seq_length: int, batch_size: int,
                                             one_hot=one_hot,
                                             pad_label=pad_label)
             >> SampleToBatch(batch_size))
+
+
+def lm_dataset(token_lists, dictionary, seq_length: int, batch_size: int,
+               one_hot: bool = False, packed: bool = False,
+               distributed: bool = False):
+    """Build the LM DataSet for a list of token lists.
+
+    ``packed=False``: lazy per-sentence pipeline (one sample per record —
+    the record count IS the epoch length).  ``packed=True``: documents are
+    packed into dense windows EAGERLY and the windows become the dataset's
+    records, so ``dataset.size()`` — which drives max_epoch, every_epoch
+    checkpoints, and validation triggers — counts windows, not sentences
+    (a lazy packer under a sentence-sized dataset would make one "epoch"
+    cover many passes, or a fraction of one).  A corpus whose token count
+    cannot fill a single window fails loudly instead of yielding an empty
+    dataset that validators reduce to None."""
+    from bigdl_tpu.dataset import DataSet, text
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+
+    vocab = dictionary.vocab_size()
+    pad_label = dictionary.get_index(text.SENTENCE_END) + 1
+    to_sample = text.LabeledSentenceToSample(
+        vocab, fixed_length=seq_length, one_hot=one_hot, pad_label=pad_label)
+    if not packed:
+        return DataSet.array(token_lists, distributed=distributed) >> (
+            text.TextToLabeledSentence(dictionary)
+            >> to_sample >> SampleToBatch(batch_size))
+    windows = list(text.DocumentPacker(dictionary, seq_length)(
+        iter(token_lists)))
+    if not windows:
+        total = sum(len(t) for t in token_lists)
+        raise SystemExit(
+            f"--packed: the corpus split has {total} tokens, fewer than "
+            f"one {seq_length}-token window needs ({seq_length + 1}) — "
+            f"reduce --seqLength or provide more text")
+    return DataSet.array(windows, distributed=distributed) >> (
+        to_sample >> SampleToBatch(batch_size))
 
 
 def restore_optim_state(optimizer, method, state_path: str) -> None:

@@ -163,6 +163,59 @@ class TestTextTransformers:
         assert s.label[0] == ls.label[0] + 1  # 1-based
 
 
+class TestDocumentPacker:
+    def test_dense_windows(self):
+        d = text.Dictionary([["a", "b", "c", "d", "e"]], vocab_size=10)
+        toks = [["a", "b", "c"], ["d", "e", "a", "b"], ["c", "d"]]
+        windows = list(text.DocumentPacker(d, seq_length=4)(iter(toks)))
+        stream = [d.get_index(t) for doc in toks for t in doc]  # 9 ids
+        # window k covers stream[k*4 : k*4+5]; only 1 full window (needs 5
+        # ids; the second would need ids 4..8 -> fits! 9 ids -> windows at
+        # offset 0 and 4)
+        assert len(windows) == 2
+        for k, w in enumerate(windows):
+            np.testing.assert_array_equal(w.data, stream[k * 4:k * 4 + 4])
+            np.testing.assert_array_equal(w.label,
+                                          stream[k * 4 + 1:k * 4 + 5])
+
+    def test_stride_overlap(self):
+        d = text.Dictionary([["a", "b", "c", "d"]], vocab_size=10)
+        toks = [["a", "b", "c", "d", "a", "b", "c"]]
+        windows = list(text.DocumentPacker(d, seq_length=4,
+                                           stride=2)(iter(toks)))
+        assert len(windows) == 2  # offsets 0 and 2 (7 ids: both need 5)
+        stream = [d.get_index(t) for t in toks[0]]
+        np.testing.assert_array_equal(windows[1].data, stream[2:6])
+        np.testing.assert_array_equal(windows[1].label, stream[3:7])
+
+    def test_packed_dataset_shapes_and_epoch_size(self):
+        from bigdl_tpu.models.utils import lm_corpus, lm_dataset
+
+        raw = "the quick brown fox jumps over the lazy dog. " * 20
+        token_lists, d = lm_corpus(raw, vocab_size=50)
+        ds = lm_dataset(token_lists, d, seq_length=8, batch_size=4,
+                        packed=True)
+        total_tokens = sum(len(t) for t in token_lists)
+        # epoch accounting: size() counts WINDOWS (max_epoch and the
+        # every_epoch triggers depend on it), not sentences
+        assert ds.size() == (total_tokens - 1) // 8
+        batch = next(ds.data(train=False))
+        assert batch.data.shape == (4, 8)
+        assert batch.labels.shape == (4, 8)
+        # dense: inputs shifted by one against labels within the stream
+        # (both are 1-based: feature = id+1, label = next id+1)
+        np.testing.assert_array_equal(batch.data[0, 1:],
+                                      batch.labels[0, :-1])
+
+    def test_packed_too_small_corpus_fails_loudly(self):
+        from bigdl_tpu.models.utils import lm_corpus, lm_dataset
+
+        token_lists, d = lm_corpus("tiny corpus.", vocab_size=50)
+        with pytest.raises(SystemExit, match="seqLength"):
+            lm_dataset(token_lists, d, seq_length=4096, batch_size=4,
+                       packed=True)
+
+
 class TestSyntheticData:
     def test_mnist_synthetic(self):
         recs = mnist.synthetic(32)

@@ -276,6 +276,27 @@ class TestLmPerf:
 
 
 class TestTransformerClis:
+    def test_packed_train_then_test(self, tmp_path, capsys):
+        """--packed trains on dense windows and evaluates on the SAME
+        pipeline (a padded-pipeline eval of a packed-trained model would
+        measure mostly pad positions)."""
+        from bigdl_tpu.models.transformer import test as t_test
+        from bigdl_tpu.models.transformer import train as t_train
+
+        model_dir = tmp_path / "ckpt"
+        model_dir.mkdir()
+        t_train.main(["--synthetic", "-e", "1", "-b", "4",
+                      "--hiddenSize", "16", "--nHead", "2",
+                      "--nLayers", "1", "--seqLength", "16", "--packed",
+                      "--checkpoint", str(model_dir)])
+        ckpts = sorted(model_dir.glob("model.*"),
+                       key=lambda p: int(p.name.split(".")[-1]))
+        assert ckpts
+        t_test.main(["--model", str(ckpts[-1]), "--synthetic",
+                     "--dictionary", str(model_dir / "dictionary.json"),
+                     "-b", "4", "--seqLength", "16", "--packed"])
+        assert "Perplexity" in capsys.readouterr().out
+
     def test_train_then_test(self, tmp_path, capsys):
         from bigdl_tpu.models.transformer import test as t_test
         from bigdl_tpu.models.transformer import train as t_train
