@@ -36,12 +36,21 @@ class SentenceSplitter(Transformer):
 
 
 class SentenceTokenizer(Transformer):
-    """Sentence string -> token list (ref text/SentenceTokenizer.scala)."""
+    """Sentence string -> token list (ref text/SentenceTokenizer.scala).
+
+    Uses the C tokenizer from the native runtime when available (the
+    data-loader hot loop; parity with the regex is tested), falling back
+    to the pure-python regex."""
 
     _pat = re.compile(r"[A-Za-z0-9']+|[^\sA-Za-z0-9]")
 
     def transform_one(self, sentence: str) -> list[str]:
-        return self._pat.findall(sentence.lower())
+        lowered = sentence.lower()
+        from bigdl_tpu import native
+        lib = native.get()
+        if lib is not None:
+            return lib.tokenize(lowered)
+        return self._pat.findall(lowered)
 
 
 class SentenceBiPadding(Transformer):

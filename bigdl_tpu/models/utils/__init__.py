@@ -54,14 +54,11 @@ def lm_dataset(token_lists, dictionary, seq_length: int, batch_size: int,
     from bigdl_tpu.dataset import DataSet, text
     from bigdl_tpu.dataset.transformer import SampleToBatch
 
+    if not packed:
+        return DataSet.array(token_lists, distributed=distributed) >> \
+            lm_sample_pipe(dictionary, seq_length, batch_size, one_hot)
     vocab = dictionary.vocab_size()
     pad_label = dictionary.get_index(text.SENTENCE_END) + 1
-    to_sample = text.LabeledSentenceToSample(
-        vocab, fixed_length=seq_length, one_hot=one_hot, pad_label=pad_label)
-    if not packed:
-        return DataSet.array(token_lists, distributed=distributed) >> (
-            text.TextToLabeledSentence(dictionary)
-            >> to_sample >> SampleToBatch(batch_size))
     windows = list(text.DocumentPacker(dictionary, seq_length)(
         iter(token_lists)))
     if not windows:
@@ -70,6 +67,8 @@ def lm_dataset(token_lists, dictionary, seq_length: int, batch_size: int,
             f"--packed: the corpus split has {total} tokens, fewer than "
             f"one {seq_length}-token window needs ({seq_length + 1}) — "
             f"reduce --seqLength or provide more text")
+    to_sample = text.LabeledSentenceToSample(
+        vocab, fixed_length=seq_length, one_hot=one_hot, pad_label=pad_label)
     return DataSet.array(windows, distributed=distributed) >> (
         to_sample >> SampleToBatch(batch_size))
 

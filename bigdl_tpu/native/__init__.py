@@ -94,6 +94,9 @@ def _load() -> ctypes.CDLL | None:
                                         i64p, i64p,
                                         ctypes.POINTER(ctypes.c_float),
                                         ctypes.c_int64]
+    dll.bt_tokenize.restype = ctypes.c_int64
+    dll.bt_tokenize.argtypes = [ctypes.c_char_p, ctypes.c_int64, i64p, i64p,
+                                ctypes.c_int64]
     return dll
 
 
@@ -229,6 +232,27 @@ class _Lib:
         if n == -5:
             raise ValueError("SequenceFile key has a non-numeric label")
         return offsets[:n], lengths[:n], labels[:n]
+
+    def tokenize(self, text: str) -> list:
+        """Word tokenization of an (already lowercased) string — the C
+        twin of dataset/text.py SentenceTokenizer's regex: word-char runs
+        as one token, any other single code point as one token.  Returns
+        the token strings."""
+        import numpy as np
+        data = text.encode("utf-8")
+        if not data:
+            return []
+        max_n = len(data)
+        starts = np.empty(max_n, dtype=np.int64)
+        ends = np.empty(max_n, dtype=np.int64)
+        n = self.dll.bt_tokenize(
+            data, len(data),
+            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), max_n)
+        if n < 0:  # cannot happen with max_n = byte count; defensive
+            raise ValueError("tokenizer overflow")
+        return [data[starts[i]:ends[i]].decode("utf-8", "replace")
+                for i in range(n)]
 
 
 lib = _Lib()

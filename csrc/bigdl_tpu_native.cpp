@@ -346,4 +346,84 @@ int64_t bt_hadoop_seq_index(const uint8_t* buf, int64_t len,
     return cnt;
 }
 
+// --------------------------------------------------------------------- //
+// Word tokenizer for the text data loader (the host-side hot loop of    //
+// dataset/text.py SentenceTokenizer; the reference's OpenNLP tokenizer  //
+// runs in the JVM — this is its native-runtime counterpart).            //
+// Semantics mirror the python regex  [A-Za-z0-9']+|[^\sA-Za-z0-9]  over //
+// an already-lowercased UTF-8 buffer: runs of word chars become one     //
+// token, any other single CODE POINT (not byte) becomes one token, and  //
+// ASCII whitespace separates.  Returns token count, or -1 when the      //
+// output arrays are too small; byte [start, end) offsets land in        //
+// starts/ends.                                                          //
+// --------------------------------------------------------------------- //
+
+static inline bool tok_word(uint8_t c) {
+    return (c >= 'a' && c <= 'z') || (c >= 'A' && c <= 'Z') ||
+           (c >= '0' && c <= '9') || c == '\'';
+}
+
+// python's str \s class: ASCII whitespace + file/group/record/unit
+// separators + NEL + the Unicode space code points — parity with the
+// regex fallback requires the full set, not just ASCII (corpora are
+// full of NBSP/em-spaces)
+static inline bool tok_space_cp(uint32_t cp) {
+    switch (cp) {
+        case 0x09: case 0x0A: case 0x0B: case 0x0C: case 0x0D: case 0x20:
+        case 0x1C: case 0x1D: case 0x1E: case 0x1F:
+        case 0x85: case 0xA0: case 0x1680:
+        case 0x2028: case 0x2029: case 0x202F: case 0x205F: case 0x3000:
+            return true;
+        default:
+            return cp >= 0x2000 && cp <= 0x200A;
+    }
+}
+
+// decode one UTF-8 code point at s[i]; writes its value, returns its
+// byte length (invalid leads decode as one replacement byte)
+static inline int64_t tok_decode_cp(const uint8_t* s, int64_t len,
+                                    int64_t i, uint32_t* cp) {
+    uint8_t lead = s[i];
+    int64_t n;
+    uint32_t v;
+    if (lead < 0x80) { *cp = lead; return 1; }
+    else if ((lead >> 5) == 0x6) { n = 2; v = lead & 0x1F; }
+    else if ((lead >> 4) == 0xE) { n = 3; v = lead & 0x0F; }
+    else if ((lead >> 3) == 0x1E) { n = 4; v = lead & 0x07; }
+    else { *cp = 0xFFFD; return 1; }
+    if (i + n > len) { *cp = 0xFFFD; return 1; }
+    for (int64_t k = 1; k < n; ++k) {
+        if ((s[i + k] & 0xC0) != 0x80) { *cp = 0xFFFD; return 1; }
+        v = (v << 6) | (s[i + k] & 0x3F);
+    }
+    *cp = v;
+    return n;
+}
+
+int64_t bt_tokenize(const uint8_t* s, int64_t len,
+                    int64_t* starts, int64_t* ends, int64_t max_tokens) {
+    int64_t n = 0, i = 0;
+    while (i < len) {
+        uint8_t c = s[i];
+        if (tok_word(c)) {
+            if (n >= max_tokens) return -1;
+            int64_t start = i;
+            while (i < len && tok_word(s[i])) ++i;
+            starts[n] = start;
+            ends[n] = i;
+            ++n;
+            continue;
+        }
+        uint32_t cp;
+        int64_t cl = tok_decode_cp(s, len, i, &cp);
+        if (tok_space_cp(cp)) { i += cl; continue; }
+        if (n >= max_tokens) return -1;
+        starts[n] = i;
+        ends[n] = i + cl;
+        ++n;
+        i += cl;
+    }
+    return n;
+}
+
 }  // extern "C"

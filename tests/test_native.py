@@ -170,3 +170,41 @@ class TestShardIndex:
         data = b"hello shard"
         assert native.lib.dll.bt_crc32(data, len(data), 0) == \
             (zlib.crc32(data) & 0xFFFFFFFF)
+
+
+class TestNativeTokenizer:
+    def test_matches_python_regex(self):
+        import re
+
+        from bigdl_tpu.dataset.text import SentenceTokenizer
+
+        if native.get() is None:
+            pytest.skip("native lib not active")
+        pat = SentenceTokenizer._pat
+        cases = [
+            "Hello, world!",
+            "it's 42 degrees... really?!",
+            "a+b=c; x_y [z] {w} 'quoted' don't",
+            "tabs\tand\nnewlines  multiple   spaces",
+            "unicode: café naïve — dash µm",
+            "",
+            "'''",
+            "ALLCAPS lower 0123456789",
+            # python \s is UNICODE whitespace: NBSP, em/en spaces, line
+            # and paragraph separators, NEL, the C0 separators — the
+            # native tokenizer must skip the same set
+            "a\xa0b nbsp",
+            "em space en space thin space",
+            "line sep para sep nel\x85done",
+            "fs\x1cgs\x1drs\x1eus\x1fend",
+            "ideographic　space ogham mark",
+            "narrow nbsp math space zero widthish",
+        ]
+        for s in cases:
+            assert native.lib.tokenize(s.lower()) == pat.findall(s.lower()), s
+
+    def test_tokenizer_transformer_uses_native(self):
+        from bigdl_tpu.dataset.text import SentenceTokenizer
+
+        toks = SentenceTokenizer().transform_one("The quick (brown) fox!")
+        assert toks == ["the", "quick", "(", "brown", ")", "fox", "!"]
