@@ -1,0 +1,171 @@
+"""Autoregressive decoding for ``TransformerLM`` (post-reference
+capability: an LM family is not complete without sampling).
+
+TPU-first decode: the whole generation loop is ONE jitted ``lax.scan``
+over a static-shape KV cache — no per-token retracing, no dynamic
+shapes.  Each step writes the new position's k/v into the cache with
+``dynamic_update_slice`` and attends over the full cache under a
+position mask, so step cost is O(T) and the (T, T) matrix never exists.
+Prefill runs the prompt in one batched pass (the same block math as
+``TransformerLM.f``) and records every position's k/v.
+
+Greedy (temperature=0) decoding is oracle-tested against the naive
+full-recompute argmax over ``model.apply``.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.models.transformer import TransformerLM
+
+
+def _split_heads(mha, x):  # (B, T, H*D) -> (B, H, T, D)
+    b, t, _ = x.shape
+    return x.reshape(b, t, mha.n_head, mha.head_dim).transpose(0, 2, 1, 3)
+
+
+def _block_qkv(model, bp, h):
+    """One block's q/k/v for a (B, T, hidden) slice, pre-attention."""
+    a = model._layer_norm(bp["ln1"], h)
+    return model._mha.project_qkv(bp["attn"], a, a, a)
+
+
+def _finish_block(model, bp, h, o):
+    h = h + model._mha.project_out(bp["attn"], o)
+    m = model._layer_norm(bp["ln2"], h)
+    m = jax.nn.gelu(m @ bp["w1"] + bp["b1"], approximate=True)
+    return h + (m @ bp["w2"] + bp["b2"])
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _prefill(model, params, ids0, cache_len):
+    """Run the prompt once; return (hidden-after-all-blocks last position
+    logits, k-cache, v-cache) with caches (L, B, H, cache_len, D)."""
+    b, t = ids0.shape
+    h = params["embed"][ids0] + params["pos"][:t]
+
+    def body(h, bp):
+        q, k, v = _block_qkv(model, bp, h)
+        from bigdl_tpu.nn.attention import dot_product_attention
+        o = dot_product_attention(q, k, v, causal=True)
+        h = _finish_block(model, bp, h, o)
+        pad = cache_len - t
+        kc = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        vc = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        return h, (kc, vc)
+
+    h, (k_cache, v_cache) = lax.scan(body, h, params["blocks"])
+    h = model._layer_norm(params["ln_f"], h[:, -1:])
+    head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
+            else params["head"].astype(h.dtype))
+    logits = (h @ head)[:, 0]
+    return logits.astype(jnp.float32), k_cache, v_cache
+
+
+def _decode_step(model, params, token, pos, k_cache, v_cache):
+    """One cached decode step: token (B,) 0-based, pos scalar index of the
+    position being *written*.  Returns (next logits, caches')."""
+    mha = model._mha
+    h = params["embed"][token][:, None, :] + lax.dynamic_slice(
+        params["pos"], (pos, 0), (1, params["pos"].shape[1]))
+    cache_len = k_cache.shape[3]
+    # mask over cache positions: attend to <= pos
+    mask = (jnp.arange(cache_len) <= pos)[None, None, None, :]
+
+    def body(carry, layer):
+        h = carry
+        bp, kc, vc = layer
+        q, k, v = _block_qkv(model, bp, h)  # q,k,v: (B, H, 1, D)
+        kc = lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
+        vc = lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            kc.astype(jnp.float32))
+        scores = scores / jnp.sqrt(jnp.float32(mha.head_dim))
+        scores = jnp.where(mask, scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", w, vc.astype(jnp.float32))
+        h = _finish_block(model, bp, h, o.astype(h.dtype))
+        return h, (kc, vc)
+
+    h, (k_cache, v_cache) = lax.scan(body, h,
+                                     (params["blocks"], k_cache, v_cache))
+    h = model._layer_norm(params["ln_f"], h)
+    head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
+            else params["head"].astype(h.dtype))
+    logits = (h @ head)[:, 0]
+    return logits.astype(jnp.float32), k_cache, v_cache
+
+
+@functools.partial(jax.jit, static_argnums=(0, 2))
+def _decode_scan(model, params, max_new, first_token, pos0,
+                 k_cache, v_cache, rng, temperature):
+    """max_new cached steps under one scan.  first_token is 0-based."""
+
+    def step(carry, key):
+        token, pos, kc, vc = carry
+        logits, kc, vc = _decode_step(model, params, token, pos, kc, vc)
+        greedy = jnp.argmax(logits, axis=-1)
+        sampled = jax.random.categorical(key, logits / jnp.maximum(
+            temperature, 1e-6), axis=-1)
+        nxt = jnp.where(temperature > 0.0, sampled, greedy)
+        return (nxt, pos + 1, kc, vc), nxt
+
+    keys = jax.random.split(rng, max_new)
+    (_, _, _, _), out = lax.scan(
+        step, (first_token, pos0, k_cache, v_cache), keys)
+    return out.T  # (B, max_new), 0-based
+
+
+def generate(model: TransformerLM, params, prompt_ids, max_new_tokens: int,
+             *, temperature: float = 0.0, rng=None, cache_len: Optional[int] = None):
+    """Generate ``max_new_tokens`` continuations of ``prompt_ids`` (B, T)
+    1-based ids.  temperature=0 -> greedy argmax; >0 -> softmax sampling
+    driven by ``rng``.  Returns (B, T + max_new_tokens) 1-based ids.
+
+    ``cache_len`` defaults to prompt+new (must be <= model.max_len —
+    positions beyond the table would silently clamp otherwise)."""
+    ids = jnp.asarray(prompt_ids)
+    if jnp.issubdtype(ids.dtype, jnp.floating):
+        ids = ids.astype(jnp.int32)
+    b, t = ids.shape
+    if t == 0:
+        raise ValueError("empty prompt: generation needs at least one "
+                         "prompt token")
+    if max_new_tokens <= 0:
+        return ids
+    total = t + int(max_new_tokens)
+    cache_len = int(cache_len) if cache_len is not None else total
+    if cache_len > model.max_len or total > model.max_len:
+        raise ValueError(
+            f"prompt + new tokens ({total}) exceeds the model's max_len "
+            f"({model.max_len})")
+    if cache_len < total:
+        # dynamic_update_slice CLAMPS out-of-range starts: steps past the
+        # cache end would silently overwrite the last slot and corrupt
+        # the decode (no sliding-window attention is implemented)
+        raise ValueError(
+            f"cache_len ({cache_len}) smaller than prompt + new tokens "
+            f"({total})")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    ids0 = ids - 1
+    logits, k_cache, v_cache = _prefill(model, params, ids0, cache_len)
+    greedy = jnp.argmax(logits, axis=-1)
+    if temperature > 0.0:
+        rng, sub = jax.random.split(rng)
+        first = jax.random.categorical(sub, logits / temperature, axis=-1)
+    else:
+        first = greedy
+    if max_new_tokens == 1:
+        return jnp.concatenate([ids, first[:, None] + 1], axis=1)
+    rest = _decode_scan(model, params, int(max_new_tokens) - 1,
+                        first, jnp.int32(t), k_cache, v_cache, rng,
+                        jnp.float32(temperature))
+    out = jnp.concatenate([first[:, None], rest], axis=1)
+    return jnp.concatenate([ids, out + 1], axis=1)

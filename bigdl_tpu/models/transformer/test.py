@@ -27,6 +27,13 @@ def main(argv=None) -> None:
     p.add_argument("--packed", action="store_true",
                    help="evaluate on dense packed windows — must match "
                         "how the model was trained")
+    p.add_argument("--generate", type=int, default=0, metavar="N",
+                   help="after evaluation, greedily decode N tokens from "
+                        "--prompt and print them")
+    p.add_argument("--prompt", default="the",
+                   help="generation prompt (tokenized with the pipeline's "
+                        "tokenizer)")
+    p.add_argument("--temperature", type=float, default=0.0)
     p.add_argument("--synthetic", action="store_true")
     args = p.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -56,6 +63,24 @@ def main(argv=None) -> None:
         # perplexity = exp(mean loss): derived from the same accumulation
         # instead of a second criterion pass per batch
         print(f"Perplexity is {PerplexityResult(result.loss, result.count)}")
+
+    if args.generate:
+        import jax
+        import jax.numpy as jnp
+
+        from bigdl_tpu.models.transformer.generate import generate
+
+        tokens = text.SentenceTokenizer().transform_one(args.prompt)
+        if not tokens:
+            raise SystemExit(f"--prompt {args.prompt!r} tokenizes to "
+                             f"nothing; provide at least one word")
+        ids = jnp.asarray([[dictionary.get_index(t) + 1 for t in tokens]],
+                          jnp.int32)
+        out = generate(model, model.params, ids, args.generate,
+                       temperature=args.temperature,
+                       rng=jax.random.PRNGKey(0))
+        words = [dictionary.get_word(int(i) - 1) for i in out[0]]
+        print("generated:", " ".join(words))
 
 
 if __name__ == "__main__":

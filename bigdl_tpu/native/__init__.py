@@ -97,6 +97,9 @@ def _load() -> ctypes.CDLL | None:
     dll.bt_tokenize.restype = ctypes.c_int64
     dll.bt_tokenize.argtypes = [ctypes.c_char_p, ctypes.c_int64, i64p, i64p,
                                 ctypes.c_int64]
+    dll.bt_tokenize_join.restype = ctypes.c_int64
+    dll.bt_tokenize_join.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                     ctypes.c_char_p, ctypes.c_int64]
     return dll
 
 
@@ -236,23 +239,20 @@ class _Lib:
     def tokenize(self, text: str) -> list:
         """Word tokenization of an (already lowercased) string — the C
         twin of dataset/text.py SentenceTokenizer's regex: word-char runs
-        as one token, any other single code point as one token.  Returns
-        the token strings."""
-        import numpy as np
+        as one token, any other single code point as one token.  One
+        buffer crossing: C writes the tokens newline-joined, python does
+        a single decode + split."""
         data = text.encode("utf-8")
         if not data:
             return []
-        max_n = len(data)
-        starts = np.empty(max_n, dtype=np.int64)
-        ends = np.empty(max_n, dtype=np.int64)
-        n = self.dll.bt_tokenize(
-            data, len(data),
-            starts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-            ends.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), max_n)
-        if n < 0:  # cannot happen with max_n = byte count; defensive
+        cap = 2 * len(data)
+        out = ctypes.create_string_buffer(cap)
+        n = self.dll.bt_tokenize_join(data, len(data), out, cap)
+        if n < 0:  # cannot happen with cap = 2x byte count; defensive
             raise ValueError("tokenizer overflow")
-        return [data[starts[i]:ends[i]].decode("utf-8", "replace")
-                for i in range(n)]
+        if n == 0:
+            return []
+        return out.raw[:n].decode("utf-8", "replace").split("\n")
 
 
 lib = _Lib()

@@ -400,6 +400,41 @@ static inline int64_t tok_decode_cp(const uint8_t* s, int64_t len,
     return n;
 }
 
+// Single-pass variant for the python wrapper's hot path: tokens are
+// written '\n'-separated into ``out`` (newline is whitespace, so it can
+// never occur inside a token) — ONE buffer crossing + ONE decode/split
+// on the python side instead of a per-token round trip.  Returns the
+// output byte length, or -1 when ``cap`` is too small (callers size
+// cap = 2 * len: worst case is one byte per token plus a separator).
+int64_t bt_tokenize_join(const uint8_t* s, int64_t len,
+                         uint8_t* out, int64_t cap) {
+    int64_t o = 0, i = 0;
+    bool first = true;
+    while (i < len) {
+        uint8_t c = s[i];
+        int64_t start, end;
+        if (tok_word(c)) {
+            start = i;
+            while (i < len && tok_word(s[i])) ++i;
+            end = i;
+        } else {
+            uint32_t cp;
+            int64_t cl = tok_decode_cp(s, len, i, &cp);
+            if (tok_space_cp(cp)) { i += cl; continue; }
+            start = i;
+            end = i + cl;
+            i += cl;
+        }
+        int64_t tok = end - start;
+        if (o + tok + 1 > cap) return -1;
+        if (!first) out[o++] = '\n';
+        std::memcpy(out + o, s + start, tok);
+        o += tok;
+        first = false;
+    }
+    return o;
+}
+
 int64_t bt_tokenize(const uint8_t* s, int64_t len,
                     int64_t* starts, int64_t* ends, int64_t max_tokens) {
     int64_t n = 0, i = 0;

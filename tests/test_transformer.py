@@ -265,6 +265,74 @@ class TestSequenceParallelLM:
                                    atol=1e-5)
 
 
+class TestGeneration:
+    def _model(self):
+        from bigdl_tpu.models import TransformerLM
+        return TransformerLM(vocab_size=13, hidden_size=16, n_head=2,
+                             n_layers=2, max_len=24).build(seed=7)
+
+    def test_greedy_matches_full_recompute(self):
+        """KV-cached decode must equal the naive argmax loop that re-runs
+        the whole model per token."""
+        from bigdl_tpu.models.transformer.generate import generate
+
+        m = self._model()
+        prompt = jnp.asarray(np.random.RandomState(0)
+                             .randint(1, 14, size=(2, 5)).astype(np.float32))
+        out = np.asarray(generate(m, m.params, prompt, 8))
+        # naive oracle
+        ids = np.asarray(prompt, np.int32)
+        for _ in range(8):
+            logits, _ = m.apply(m.params, jnp.asarray(ids.astype(np.float32)))
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1)) + 1
+            ids = np.concatenate([ids, nxt[:, None].astype(np.int32)], axis=1)
+        np.testing.assert_array_equal(out, ids)
+
+    def test_sampling_reproducible_and_varied(self):
+        from bigdl_tpu.models.transformer.generate import generate
+
+        m = self._model()
+        prompt = jnp.ones((1, 3), jnp.float32)
+        a = np.asarray(generate(m, m.params, prompt, 10, temperature=1.0,
+                                rng=jax.random.PRNGKey(1)))
+        b = np.asarray(generate(m, m.params, prompt, 10, temperature=1.0,
+                                rng=jax.random.PRNGKey(1)))
+        c = np.asarray(generate(m, m.params, prompt, 10, temperature=1.0,
+                                rng=jax.random.PRNGKey(2)))
+        np.testing.assert_array_equal(a, b)  # same key -> same sample
+        assert not np.array_equal(a, c)      # different key -> different
+        assert a.min() >= 1 and a.max() <= 13  # 1-based id range
+
+    def test_rejects_overlong(self):
+        from bigdl_tpu.models.transformer.generate import generate
+
+        m = self._model()
+        with pytest.raises(ValueError, match="max_len"):
+            generate(m, m.params, jnp.ones((1, 20), jnp.float32), 10)
+
+    def test_memorized_sequence_completion(self):
+        """Train to memorize one sequence; greedy decode completes it."""
+        from bigdl_tpu.dataset import DataSet, Sample
+        from bigdl_tpu.dataset.transformer import SampleToBatch
+        from bigdl_tpu.models import TransformerLM
+        from bigdl_tpu.models.transformer.generate import generate
+        from bigdl_tpu.optim import Adam, LocalOptimizer, Trigger
+
+        seq = np.asarray([3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8], np.float32)
+        samples = [Sample(seq[:-1], seq[1:])]
+        ds = DataSet.array(samples) >> SampleToBatch(1, drop_last=True)
+        m = TransformerLM(vocab_size=10, hidden_size=32, n_head=2,
+                          n_layers=2, max_len=16).build(seed=1)
+        opt = LocalOptimizer(
+            m, ds, nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True))
+        opt.set_optim_method(Adam(learning_rate=0.01)) \
+           .set_end_when(Trigger.max_iteration(150))
+        opt.optimize()
+        out = np.asarray(generate(m, m.params,
+                                  jnp.asarray(seq[None, :4]), 7))
+        np.testing.assert_array_equal(out[0], seq[:11].astype(np.int64))
+
+
 class TestLmPerf:
     def test_smoke(self):
         from bigdl_tpu.models.utils.lm_perf import run_lm_perf
