@@ -32,6 +32,24 @@ from bigdl_tpu import nn
 from bigdl_tpu.nn.module import Module
 
 
+def apply_rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding (rotate-half convention): x (..., T, D)
+    with D even, positions (T,) absolute indices.  Attention scores then
+    depend only on RELATIVE position — no learned table, graceful
+    behavior past training lengths, and exact compatibility with KV
+    caches (keys are rotated once, at their own position)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.asarray(base, jnp.float32) ** (
+        -jnp.arange(0, half, dtype=jnp.float32) * 2.0 / d)
+    ang = positions.astype(jnp.float32)[..., :, None] * freqs  # (T, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate([(x1 * cos - x2 * sin).astype(x.dtype),
+                            (x2 * cos + x1 * sin).astype(x.dtype)], -1)
+
+
 class TransformerLM(Module):
     """Causal transformer LM over 1-based token ids.
 
@@ -45,9 +63,16 @@ class TransformerLM(Module):
                  ffn_size: Optional[int] = None, max_len: int = 512,
                  dropout: float = 0.0, tie_embeddings: bool = True,
                  remat: bool = False, attention_impl: str = "auto",
-                 block_size: Optional[int] = None):
+                 block_size: Optional[int] = None,
+                 pos_encoding: str = "learned",
+                 rope_base: float = 10000.0):
         super().__init__()
         assert hidden_size % n_head == 0
+        if pos_encoding not in ("learned", "rope"):
+            raise ValueError(f"pos_encoding must be 'learned' or 'rope', "
+                             f"got {pos_encoding!r}")
+        if pos_encoding == "rope" and (hidden_size // n_head) % 2 != 0:
+            raise ValueError("rope needs an even head_dim")
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.n_head = n_head
@@ -57,6 +82,8 @@ class TransformerLM(Module):
         self.dropout = dropout
         self.tie_embeddings = tie_embeddings
         self.remat = remat
+        self.pos_encoding = pos_encoding
+        self.rope_base = rope_base
         # attention plumbing (projections + kernel choice) is shared with
         # the standalone nn.MultiHeadAttention so there is one hot path
         self._mha = nn.MultiHeadAttention(
@@ -90,10 +117,11 @@ class TransformerLM(Module):
             jax.random.split(k_blocks, self.n_layers))
         p = {
             "embed": jax.random.normal(k_emb, (v, h)) * std,
-            "pos": jax.random.normal(k_pos, (self.max_len, h)) * std,
             "blocks": blocks,
             "ln_f": {"weight": jnp.ones((h,)), "bias": jnp.zeros((h,))},
         }
+        if self.pos_encoding == "learned":
+            p["pos"] = jax.random.normal(k_pos, (self.max_len, h)) * std
         if not self.tie_embeddings:
             p["head"] = jax.random.uniform(k_head, (h, v), jnp.float32,
                                            -std, std)
@@ -105,10 +133,18 @@ class TransformerLM(Module):
         from bigdl_tpu.nn.normalization import layer_norm
         return layer_norm(x, p["weight"], p["bias"])
 
-    def _block(self, bp, x, training: bool, rng):
+    def _rope(self, q, k, positions):
+        if self.pos_encoding != "rope":
+            return q, k
+        return (apply_rope(q, positions, self.rope_base),
+                apply_rope(k, positions, self.rope_base))
+
+    def _block(self, bp, x, training: bool, rng, positions=None):
         mha = self._mha
         a = self._layer_norm(bp["ln1"], x)
         q, k, v = mha.project_qkv(bp["attn"], a, a, a)
+        if positions is not None:
+            q, k = self._rope(q, k, positions)
         if mha.attention_impl == "flash":
             from bigdl_tpu.ops import flash_attention
             bs = mha.block_size or 128
@@ -137,7 +173,10 @@ class TransformerLM(Module):
             ids = ids.astype(jnp.int32)
         ids = ids - 1  # 1-based API edge -> 0-based gather
         t = ids.shape[-1]
-        h = params["embed"][ids] + params["pos"][:t]
+        h = params["embed"][ids]
+        if self.pos_encoding == "learned":
+            h = h + params["pos"][:t]
+        positions = jnp.arange(t)
         if rng is None:
             if training and self.dropout > 0.0:
                 raise ValueError(
@@ -150,7 +189,8 @@ class TransformerLM(Module):
                  if self.remat else self._block)
         keys = jax.random.split(rng, self.n_layers)
         h, _ = jax.lax.scan(
-            lambda carry, layer: (block(layer[0], carry, training, layer[1]),
+            lambda carry, layer: (block(layer[0], carry, training, layer[1],
+                                        positions),
                                   None),
             h, (params["blocks"], keys))
         h = self._layer_norm(params["ln_f"], h)

@@ -24,11 +24,6 @@ from jax import lax
 from bigdl_tpu.models.transformer import TransformerLM
 
 
-def _split_heads(mha, x):  # (B, T, H*D) -> (B, H, T, D)
-    b, t, _ = x.shape
-    return x.reshape(b, t, mha.n_head, mha.head_dim).transpose(0, 2, 1, 3)
-
-
 def _block_qkv(model, bp, h):
     """One block's q/k/v for a (B, T, hidden) slice, pre-attention."""
     a = model._layer_norm(bp["ln1"], h)
@@ -47,12 +42,25 @@ def _prefill(model, params, ids0, cache_len):
     """Run the prompt once; return (hidden-after-all-blocks last position
     logits, k-cache, v-cache) with caches (L, B, H, cache_len, D)."""
     b, t = ids0.shape
-    h = params["embed"][ids0] + params["pos"][:t]
+    h = params["embed"][ids0]
+    if model.pos_encoding == "learned":
+        h = h + params["pos"][:t]
+    positions = jnp.arange(t)
 
     def body(h, bp):
         q, k, v = _block_qkv(model, bp, h)
-        from bigdl_tpu.nn.attention import dot_product_attention
-        o = dot_product_attention(q, k, v, causal=True)
+        q, k = model._rope(q, k, positions)
+        # honor the model's configured attention core: flash keeps the
+        # (T, T) matrix out of HBM for long prompts, exactly as in
+        # TransformerLM._block
+        if model._mha.attention_impl == "flash":
+            from bigdl_tpu.ops import flash_attention
+            bs = model._mha.block_size or 128
+            o = flash_attention(q, k, v, causal=True, block_q=bs,
+                                block_k=bs)
+        else:
+            from bigdl_tpu.nn.attention import dot_product_attention
+            o = dot_product_attention(q, k, v, causal=True)
         h = _finish_block(model, bp, h, o)
         pad = cache_len - t
         kc = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
@@ -71,8 +79,11 @@ def _decode_step(model, params, token, pos, k_cache, v_cache):
     """One cached decode step: token (B,) 0-based, pos scalar index of the
     position being *written*.  Returns (next logits, caches')."""
     mha = model._mha
-    h = params["embed"][token][:, None, :] + lax.dynamic_slice(
-        params["pos"], (pos, 0), (1, params["pos"].shape[1]))
+    h = params["embed"][token][:, None, :]
+    if model.pos_encoding == "learned":
+        h = h + lax.dynamic_slice(params["pos"], (pos, 0),
+                                  (1, params["pos"].shape[1]))
+    positions = jnp.reshape(pos, (1,))
     cache_len = k_cache.shape[3]
     # mask over cache positions: attend to <= pos
     mask = (jnp.arange(cache_len) <= pos)[None, None, None, :]
@@ -81,6 +92,7 @@ def _decode_step(model, params, token, pos, k_cache, v_cache):
         h = carry
         bp, kc, vc = layer
         q, k, v = _block_qkv(model, bp, h)  # q,k,v: (B, H, 1, D)
+        q, k = model._rope(q, k, positions)  # keys rotate at THEIR position
         kc = lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
         vc = lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
         scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),

@@ -115,13 +115,19 @@ def _sequence_parallel_apply(model, params, ids, mesh, *, seq_axis,
         ids_i = ids_i - 1
         t_local = ids_i.shape[-1]
         offset = lax.axis_index(seq_axis) * t_local
-        pos = lax.dynamic_slice(params["pos"], (offset, 0),
-                                (t_local, params["pos"].shape[1]))
-        h = params["embed"][ids_i] + pos
+        h = params["embed"][ids_i]
+        # GLOBAL positions for this shard: rope rotations and the learned
+        # table both key on them (a key rotated at its own global
+        # position stays correct as it travels the ring)
+        positions = offset + jnp.arange(t_local)
+        if model.pos_encoding == "learned":
+            h = h + lax.dynamic_slice(params["pos"], (offset, 0),
+                                      (t_local, params["pos"].shape[1]))
 
         def block(bp, h):
             a = model._layer_norm(bp["ln1"], h)
             q, k, v = mha.project_qkv(bp["attn"], a, a, a)
+            q, k = model._rope(q, k, positions)
             o = attn_fn(q, k, v)
             h = h + mha.project_out(bp["attn"], o)
             m = model._layer_norm(bp["ln2"], h)

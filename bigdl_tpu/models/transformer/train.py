@@ -33,6 +33,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--nLayers", type=int, default=2)
     p.add_argument("--seqLength", type=int, default=24)
     p.add_argument("--dropout", type=float, default=0.0)
+    p.add_argument("--posEncoding", default="learned",
+                   choices=["learned", "rope"],
+                   help="rope = rotary (relative) positions, no learned "
+                        "table — the long-context default")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (long-sequence memory)")
     p.add_argument("--packed", action="store_true",
@@ -88,7 +92,8 @@ def main(argv=None) -> None:
     model = nn.Module.load(args.model) if args.model else \
         TransformerLM(vocab, hidden_size=args.hiddenSize, n_head=args.nHead,
                       n_layers=args.nLayers, max_len=args.seqLength,
-                      dropout=args.dropout, remat=args.remat).build(seed=1)
+                      dropout=args.dropout, remat=args.remat,
+                      pos_encoding=args.posEncoding).build(seed=1)
     criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
     method = {"sgd": SGD, "adam": Adam, "adamw": AdamW}[args.optim](
         learning_rate=args.learningRate, weight_decay=args.weightDecay)

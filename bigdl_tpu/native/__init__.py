@@ -54,6 +54,22 @@ def _load() -> ctypes.CDLL | None:
             dll = ctypes.CDLL(_SO)
         except OSError:
             return None
+    try:
+        return _set_prototypes(dll)
+    except AttributeError:
+        # a stale prebuilt .so missing a newer symbol (source tree absent
+        # or mtimes preserved by rsync/tar): one rebuild attempt, else
+        # fall back to pure python — 'lib is None' must only cost speed
+        with _lock:
+            if not _build():
+                return None
+            try:
+                return _set_prototypes(ctypes.CDLL(_SO))
+            except (OSError, AttributeError):
+                return None
+
+
+def _set_prototypes(dll: ctypes.CDLL) -> ctypes.CDLL:
     u8p = ctypes.POINTER(ctypes.c_uint8)
     f64p = ctypes.POINTER(ctypes.c_double)
     i64p = ctypes.POINTER(ctypes.c_int64)
