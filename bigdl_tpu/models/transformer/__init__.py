@@ -65,7 +65,10 @@ class TransformerLM(Module):
                  remat: bool = False, attention_impl: str = "auto",
                  block_size: Optional[int] = None,
                  pos_encoding: str = "learned",
-                 rope_base: float = 10000.0):
+                 rope_base: float = 10000.0,
+                 moe_experts: int = 0,
+                 moe_capacity_factor: Optional[float] = 1.25,
+                 moe_aux_weight: float = 0.01):
         super().__init__()
         assert hidden_size % n_head == 0
         if pos_encoding not in ("learned", "rope"):
@@ -84,6 +87,19 @@ class TransformerLM(Module):
         self.remat = remat
         self.pos_encoding = pos_encoding
         self.rope_base = rope_base
+        # moe_experts > 0 swaps every block's dense MLP for a top-1
+        # switch MoE (bigdl_tpu.parallel.expert.switch_mlp); the
+        # load-balancing auxiliary loss reaches the optimizers through
+        # the reserved "aux_loss" buffers key, pre-scaled by
+        # moe_aux_weight
+        self.moe_experts = int(moe_experts)
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_aux_weight = moe_aux_weight
+        # set to the data-parallel mesh axis name when the forward runs
+        # inside shard_map with tokens sharded over it: the balance loss
+        # then averages f_e/P_e globally (DistriOptimizer sets this
+        # automatically; see expert._balance_loss for why it matters)
+        self.moe_balance_axis: Optional[str] = None
         # attention plumbing (projections + kernel choice) is shared with
         # the standalone nn.MultiHeadAttention so there is one hot path
         self._mha = nn.MultiHeadAttention(
@@ -95,17 +111,35 @@ class TransformerLM(Module):
         ks = jax.random.split(rng, 3)
         h, f = self.hidden_size, self.ffn_size
         std_h, std_f = 1.0 / math.sqrt(h), 1.0 / math.sqrt(f)
-        return {
+        p = {
             "ln1": {"weight": jnp.ones((h,)), "bias": jnp.zeros((h,))},
             "attn": self._mha.init(ks[0]),
             "ln2": {"weight": jnp.ones((h,)), "bias": jnp.zeros((h,))},
-            "w1": jax.random.uniform(ks[1], (h, f), jnp.float32,
-                                     -std_h, std_h),
-            "b1": jnp.zeros((f,)),
-            "w2": jax.random.uniform(ks[2], (f, h), jnp.float32,
-                                     -std_f, std_f),
-            "b2": jnp.zeros((h,)),
         }
+        if self.moe_experts:
+            from bigdl_tpu.parallel.expert import init_moe_params
+            p["moe"] = init_moe_params(ks[1], self.moe_experts, h, f)
+        else:
+            p["w1"] = jax.random.uniform(ks[1], (h, f), jnp.float32,
+                                         -std_h, std_h)
+            p["b1"] = jnp.zeros((f,))
+            p["w2"] = jax.random.uniform(ks[2], (f, h), jnp.float32,
+                                         -std_f, std_f)
+            p["b2"] = jnp.zeros((h,))
+        return p
+
+    def _mlp(self, bp, m):
+        """The block's feed-forward half: dense GELU MLP or switch MoE.
+        Shared by the single-device block, the sequence-parallel body
+        (token-local either way), and cached generation.  Returns
+        (out, aux) — aux is 0 for the dense path."""
+        if self.moe_experts:
+            from bigdl_tpu.parallel.expert import switch_mlp
+            return switch_mlp(bp["moe"], m,
+                              capacity_factor=self.moe_capacity_factor,
+                              balance_axis=self.moe_balance_axis)
+        m = jax.nn.gelu(m @ bp["w1"] + bp["b1"], approximate=True)
+        return m @ bp["w2"] + bp["b2"], jnp.zeros((), jnp.float32)
 
     def init(self, rng):
         k_emb, k_pos, k_head, k_blocks = jax.random.split(rng, 4)
@@ -159,15 +193,14 @@ class TransformerLM(Module):
             o = o * jax.random.bernoulli(sub, keep, o.shape) / keep
         x = x + o
         m = self._layer_norm(bp["ln2"], x)
-        m = jax.nn.gelu(m @ bp["w1"] + bp["b1"], approximate=True)
-        m = m @ bp["w2"] + bp["b2"]
+        m, aux = self._mlp(bp, m)
         if training and self.dropout > 0.0:
             rng, sub = jax.random.split(rng)
             keep = 1.0 - self.dropout
             m = m * jax.random.bernoulli(sub, keep, m.shape) / keep
-        return x + m
+        return x + m, aux
 
-    def f(self, params, x, *, training: bool = False, rng=None):
+    def _forward(self, params, x, training: bool, rng):
         ids = jnp.asarray(x)
         if jnp.issubdtype(ids.dtype, jnp.floating):
             ids = ids.astype(jnp.int32)
@@ -188,13 +221,34 @@ class TransformerLM(Module):
         block = (jax.checkpoint(self._block, static_argnums=(2,))
                  if self.remat else self._block)
         keys = jax.random.split(rng, self.n_layers)
-        h, _ = jax.lax.scan(
-            lambda carry, layer: (block(layer[0], carry, training, layer[1],
-                                        positions),
-                                  None),
+        h, auxes = jax.lax.scan(
+            lambda carry, layer: block(layer[0], carry, training, layer[1],
+                                       positions),
             h, (params["blocks"], keys))
         h = self._layer_norm(params["ln_f"], h)
         head = (params["embed"].T.astype(h.dtype) if self.tie_embeddings
                 else params["head"].astype(h.dtype))
         logits = h @ head
-        return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return logp, jnp.sum(auxes)
+
+    def f(self, params, x, *, training: bool = False, rng=None):
+        return self._forward(params, x, training, rng)[0]
+
+    def apply(self, params, x, *, buffers=None, training: bool = False,
+              rng=None):
+        """MoE models report the load-balancing term through the reserved
+        "aux_loss" buffers key (pre-scaled by ``moe_aux_weight``); the
+        optimizers add it to the training loss inside the differentiated
+        step, so the gate gradient flows through the standard
+        Optimizer/Criterion machinery."""
+        y, aux = self._forward(params, x, training, rng)
+        new_buffers = dict(buffers) if buffers else {}
+        if self.moe_experts:
+            new_buffers["aux_loss"] = self.moe_aux_weight * aux
+        return y, new_buffers
+
+    def init_buffers(self):
+        if self.moe_experts:
+            return {"aux_loss": jnp.zeros((), jnp.float32)}
+        return {}

@@ -10,7 +10,14 @@ Prefill runs the prompt in one batched pass (the same block math as
 ``TransformerLM.f``) and records every position's k/v.
 
 Greedy (temperature=0) decoding is oracle-tested against the naive
-full-recompute argmax over ``model.apply``.
+full-recompute argmax over ``model.apply``.  MoE note: decode always
+uses DENSE per-token routing (capacity-factor dropping is a batch-level
+training construct; under it a sequence's continuation would depend on
+which unrelated prompts share the dispatch window).  Exact equality with
+teacher-forced recompute therefore holds for
+``moe_capacity_factor=None`` models; capacity-trained models may diverge
+from a teacher-forced pass exactly where the full window would have
+dropped tokens.
 """
 from __future__ import annotations
 
@@ -33,8 +40,17 @@ def _block_qkv(model, bp, h):
 def _finish_block(model, bp, h, o):
     h = h + model._mha.project_out(bp["attn"], o)
     m = model._layer_norm(bp["ln2"], h)
-    m = jax.nn.gelu(m @ bp["w1"] + bp["b1"], approximate=True)
-    return h + (m @ bp["w2"] + bp["b2"])
+    if model.moe_experts:
+        from bigdl_tpu.parallel.expert import switch_mlp
+        # DENSE routing during decode: the capacity window is a
+        # batch-level training construct — under it, a sequence's tokens
+        # would drop depending on which unrelated prompts share the
+        # dispatch, coupling batch rows.  Dense per-token routing is
+        # batch-independent and exact (aux is a training term; dropped).
+        m, _ = switch_mlp(bp["moe"], m, capacity_factor=None)
+    else:
+        m, _ = model._mlp(bp, m)
+    return h + m
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3))

@@ -99,6 +99,13 @@ def _sequence_parallel_apply(model, params, ids, mesh, *, seq_axis,
     if model.dropout > 0.0:
         raise ValueError("sequence-parallel apply does not support "
                          "dropout — build the TransformerLM with dropout=0")
+    if model.moe_experts:
+        # routing/capacity would be shard-local and the aux loss has no
+        # return path through this API; expert parallelism composes via
+        # bigdl_tpu.parallel.expert.moe_apply instead
+        raise ValueError("sequence-parallel apply does not support MoE "
+                         "blocks yet — use the single-device forward or "
+                         "parallel.expert.moe_apply")
     if ids.shape[-1] > model.max_len:
         # the per-shard dynamic_slice on the position table would CLAMP an
         # out-of-range offset and silently reuse trailing positions; fail
@@ -131,9 +138,8 @@ def _sequence_parallel_apply(model, params, ids, mesh, *, seq_axis,
             o = attn_fn(q, k, v)
             h = h + mha.project_out(bp["attn"], o)
             m = model._layer_norm(bp["ln2"], h)
-            m = jax.nn.gelu(m @ bp["w1"] + bp["b1"], approximate=True)
-            h = h + (m @ bp["w2"] + bp["b2"])
-            return h
+            m, _ = model._mlp(bp, m)
+            return h + m
 
         if model.remat:
             block = jax.checkpoint(block)

@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["learned", "rope"],
                    help="rope = rotary (relative) positions, no learned "
                         "table — the long-context default")
+    p.add_argument("--moeExperts", type=int, default=0,
+                   help="swap each block's MLP for a top-1 switch MoE "
+                        "with this many experts (0 = dense)")
     p.add_argument("--remat", action="store_true",
                    help="jax.checkpoint each block (long-sequence memory)")
     p.add_argument("--packed", action="store_true",
@@ -93,7 +96,8 @@ def main(argv=None) -> None:
         TransformerLM(vocab, hidden_size=args.hiddenSize, n_head=args.nHead,
                       n_layers=args.nLayers, max_len=args.seqLength,
                       dropout=args.dropout, remat=args.remat,
-                      pos_encoding=args.posEncoding).build(seed=1)
+                      pos_encoding=args.posEncoding,
+                      moe_experts=args.moeExperts).build(seed=1)
     criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
     method = {"sgd": SGD, "adam": Adam, "adamw": AdamW}[args.optim](
         learning_rate=args.learningRate, weight_decay=args.weightDecay)

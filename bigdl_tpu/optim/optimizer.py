@@ -322,8 +322,13 @@ class LocalOptimizer(Optimizer):
         def loss_fn(params, buffers, data, labels, rng):
             out, new_buffers = model.apply(cast(params), data, buffers=buffers,
                                            training=True, rng=rng)
-            return criterion.loss(self._outputs_to_f32(out), labels), \
-                new_buffers
+            loss = criterion.loss(self._outputs_to_f32(out), labels)
+            # reserved buffers key: model-declared differentiable
+            # auxiliary terms (e.g. MoE load balancing) join the loss
+            # INSIDE the differentiated step, pre-scaled by the model
+            if isinstance(new_buffers, dict) and "aux_loss" in new_buffers:
+                loss = loss + new_buffers["aux_loss"]
+            return loss, new_buffers
 
         def step(params, buffers, opt_state, data, labels, rng, epoch):
             (loss, new_buffers), grads = jax.value_and_grad(

@@ -103,12 +103,30 @@ class DistriOptimizer(Optimizer):
     def _build_step(self, arp: AllReduceParameter):
         model, criterion, method = self.model, self.criterion, self.optim_method
         cast = self._cast_for_compute
+        # MoE models: the balance loss must average routing stats over
+        # the token shards (see expert._balance_loss); the step below
+        # runs the forward inside shard_map over DATA_AXIS, so that is
+        # the axis to aggregate on.  Only set when the model left it to
+        # the trainer (None) — an explicit user choice wins.
+        if getattr(model, "moe_balance_axis", "absent") is None \
+                and getattr(model, "moe_experts", 0):
+            model.moe_balance_axis = DATA_AXIS
 
         def loss_fn(params, buffers, data, labels, rng):
             out, new_buffers = model.apply(cast(params), data, buffers=buffers,
                                            training=True, rng=rng)
-            return criterion.loss(self._outputs_to_f32(out), labels), \
-                new_buffers
+            loss = criterion.loss(self._outputs_to_f32(out), labels)
+            # reserved buffers key: model-declared differentiable
+            # auxiliary terms (e.g. MoE load balancing), same contract
+            # as the local loop.  pmean first: the term is computed on
+            # this device's token shard, and the stored buffer flows out
+            # through a replicated out_spec — every shard must agree
+            if isinstance(new_buffers, dict) and "aux_loss" in new_buffers:
+                aux = lax.pmean(new_buffers["aux_loss"], DATA_AXIS)
+                new_buffers = dict(new_buffers)
+                new_buffers["aux_loss"] = aux
+                loss = loss + aux
+            return loss, new_buffers
 
         def step(w_shard, opt_state, buffers, data, labels, rng, epoch):
             # per-device RNG (each reference thread-replica drew its own noise)

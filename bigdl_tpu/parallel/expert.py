@@ -50,6 +50,51 @@ def init_moe_params(rng, n_experts: int, d_model: int, d_hidden: int):
     }
 
 
+# shared routing/dispatch core — ONE definition of the top-1 routing,
+# the capacity position trick, the expert FFN (gelu, matching the dense
+# transformer block so --moeExperts A/Bs routing and nothing else), and
+# the balance loss; moe_apply_local and switch_mlp are thin shells over
+# these with/without the expert-slice + psum machinery.
+
+def _top1_route(gate, x2):
+    """-> (probs f32, onehot top-1 mask, gate value per token)."""
+    logits = x2 @ gate
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    top = jnp.argmax(probs, axis=-1)
+    onehot = jax.nn.one_hot(top, gate.shape[1], dtype=x2.dtype)
+    gate_val = jnp.sum(probs.astype(x2.dtype) * onehot, axis=-1)
+    return probs, onehot, gate_val
+
+
+def _capacity_positions(onehot, cap):
+    """(T, C) one-hot of each token's slot within its expert's queue;
+    over-capacity tokens get a zero row (the Switch drop).  Integer
+    cumsum: a bf16 cumsum stops counting exactly at 256 and would
+    silently collide capacity slots."""
+    oh_i = onehot.astype(jnp.int32)
+    pos = jnp.sum(jnp.cumsum(oh_i, axis=0) * oh_i, axis=-1) - 1
+    return jax.nn.one_hot(pos, cap, dtype=onehot.dtype)
+
+
+def _expert_ffn(w1, w2, x):
+    h = jax.nn.gelu(jnp.einsum("e...d,edh->e...h", x, w1),
+                    approximate=True)
+    return jnp.einsum("e...h,ehd->e...d", h, w2)
+
+
+def _balance_loss(onehot, probs, n_total, data_axis=None):
+    """Switch load-balancing loss n * sum_e f_e * P_e.  With
+    ``data_axis``, f_e and P_e average over token shards FIRST (averaging
+    the per-shard products would add a cross-shard covariance term and
+    penalize shard-skewed-but-globally-balanced routing)."""
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    mean_p = jnp.mean(probs, axis=0)
+    if data_axis is not None:
+        frac = lax.pmean(frac, data_axis)
+        mean_p = lax.pmean(mean_p, data_axis)
+    return n_total * jnp.sum(frac * mean_p)
+
+
 def moe_apply_local(params, x, *, axis: str = EXPERT_AXIS,
                     data_axis: Optional[str] = None,
                     capacity_factor: Optional[float] = None):
@@ -60,19 +105,14 @@ def moe_apply_local(params, x, *, axis: str = EXPERT_AXIS,
     my_idx = lax.axis_index(axis)
     n_total = params["gate"].shape[1]
 
-    logits = x @ params["gate"]                         # (T, E) global gate
-    probs = jax.nn.softmax(logits, axis=-1)
-    top = jnp.argmax(probs, axis=-1)                    # (T,) top-1 routing
-    onehot = jax.nn.one_hot(top, n_total, dtype=x.dtype)
-    gate_val = jnp.sum(probs * onehot, axis=-1)         # (T,)
+    probs, onehot, gate_val = _top1_route(params["gate"], x)
     lo = my_idx * e_local
     local_mask = lax.dynamic_slice_in_dim(onehot, lo, e_local, axis=1)
 
     if capacity_factor is None:
         # dense dispatch to the local slice only (exact; oracle path)
         dispatched = jnp.einsum("te,td->etd", local_mask, x)  # (E_l, T, D)
-        h = jax.nn.relu(jnp.einsum("etd,edh->eth", dispatched, params["w1"]))
-        out = jnp.einsum("eth,ehd->etd", h, params["w2"])     # (E_l, T, D)
+        out = _expert_ffn(params["w1"], params["w2"], dispatched)
         y_local = jnp.einsum("etd,te->td", out, local_mask)
         y = lax.psum(y_local, axis) * gate_val[:, None]
     else:
@@ -80,32 +120,49 @@ def moe_apply_local(params, x, *, axis: str = EXPERT_AXIS,
         # tokens; the (T, E, C) one-hot keeps every shape static
         t_tokens = x.shape[0]
         cap = max(1, int(math.ceil(capacity_factor * t_tokens / n_total)))
-        # 0-based position of each token within its expert's queue — in
-        # integer arithmetic: a bf16 cumsum stops counting exactly at 256
-        # and would silently collide capacity slots
-        oh_i = onehot.astype(jnp.int32)
-        pos = jnp.sum(jnp.cumsum(oh_i, axis=0) * oh_i, axis=-1) - 1
-        # over-capacity tokens drop out here: one_hot of pos >= cap is a
-        # zero row, so they reach no capacity slot
-        pos_oh = jax.nn.one_hot(pos, cap, dtype=x.dtype)
+        pos_oh = _capacity_positions(onehot, cap)
         dispatch = local_mask[:, :, None] * pos_oh[:, None, :]  # (T,E_l,C)
         expert_in = jnp.einsum("td,tec->ecd", x, dispatch)      # (E_l,C,D)
-        h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, params["w1"]))
-        out = jnp.einsum("ech,ehd->ecd", h, params["w2"])       # (E_l,C,D)
+        out = _expert_ffn(params["w1"], params["w2"], expert_in)
         combine = dispatch * gate_val[:, None, None]
         y = lax.psum(jnp.einsum("ecd,tec->td", out, combine), axis)
 
-    # switch-transformer load-balancing loss: n_total * sum_e f_e * p_e
-    frac = jnp.mean(onehot, axis=0)
-    mean_p = jnp.mean(probs, axis=0)
-    if data_axis is not None:
-        # global Switch loss: average f_e and P_e over token shards FIRST
-        # (averaging the per-shard products would add a cross-shard
-        # covariance term and penalize shard-skewed-but-balanced routing)
-        frac = lax.pmean(frac, data_axis)
-        mean_p = lax.pmean(mean_p, data_axis)
-    aux = n_total * jnp.sum(frac * mean_p)
+    aux = _balance_loss(onehot, probs, n_total, data_axis)
     return y, aux
+
+
+def switch_mlp(params, x, capacity_factor: Optional[float] = None,
+               balance_axis: Optional[str] = None):
+    """Single-device switch MoE over tokens x (..., T, D) — the same
+    routing/dispatch core as ``moe_apply_local`` with all experts
+    resident (no mesh).  This is the block ``TransformerLM`` uses for
+    ``moe_experts > 0``; the mesh version shards the same parameter
+    layout over the ``expert`` axis.  ``balance_axis``: when the call
+    runs inside shard_map with tokens sharded over that axis (data
+    parallelism), the balance loss uses globally averaged f_e/P_e so it
+    stays the unbiased Switch objective.  Returns (y, aux_loss)."""
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    n_experts = params["gate"].shape[1]
+
+    probs, onehot, gate_val = _top1_route(params["gate"], x2)
+
+    if capacity_factor is None:
+        dispatched = jnp.einsum("te,td->etd", onehot, x2)
+        out = _expert_ffn(params["w1"], params["w2"], dispatched)
+        y = jnp.einsum("etd,te->td", out, onehot) * gate_val[:, None]
+    else:
+        t_tokens = x2.shape[0]
+        cap = max(1, int(math.ceil(capacity_factor * t_tokens / n_experts)))
+        pos_oh = _capacity_positions(onehot, cap)
+        dispatch = onehot[:, :, None] * pos_oh[:, None, :]     # (T, E, C)
+        expert_in = jnp.einsum("td,tec->ecd", x2, dispatch)
+        out = _expert_ffn(params["w1"], params["w2"], expert_in)
+        combine = dispatch * gate_val[:, None, None]
+        y = jnp.einsum("ecd,tec->td", out, combine)
+
+    aux = _balance_loss(onehot, probs, n_experts, balance_axis)
+    return y.reshape(shape), aux
 
 
 def moe_apply(params, x, mesh: Mesh, *, axis: str = EXPERT_AXIS,

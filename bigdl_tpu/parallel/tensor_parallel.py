@@ -113,6 +113,11 @@ def transformer_lm_tp_rules(mesh: Mesh, axis: str = MODEL_AXIS):
         def spec(*dims):
             return NamedSharding(mesh, P(*([None] * stacked), *dims))
 
+        if "'moe'" in name:
+            # MoE experts shard over the EXPERT axis (parallel.expert),
+            # not the Megatron width axis — replicate here rather than
+            # applying 2-D width specs to the (L, E, D, H) expert stacks
+            return replicated_spec(mesh)
         if any(w in name for w in ("wq", "wk", "wv")):
             return spec(None, axis)          # (h, inner) col-parallel
         if any(b in name for b in ("bq", "bk", "bv")):

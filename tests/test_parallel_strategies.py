@@ -172,7 +172,10 @@ class TestExpertParallel:
         onehot = jax.nn.one_hot(top, n, dtype=x.dtype)
         gate_val = jnp.sum(probs * onehot, axis=-1)
         dispatched = jnp.einsum("te,td->etd", onehot, x)
-        h = jax.nn.relu(jnp.einsum("etd,edh->eth", dispatched, params["w1"]))
+        # gelu: the expert FFN matches the dense transformer block's
+        # activation so --moeExperts A/Bs routing, not the nonlinearity
+        h = jax.nn.gelu(jnp.einsum("etd,edh->eth", dispatched, params["w1"]),
+                        approximate=True)
         out = jnp.einsum("eth,ehd->etd", h, params["w2"])
         return jnp.einsum("etd,te->td", out, onehot) * gate_val[:, None]
 
