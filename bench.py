@@ -13,21 +13,133 @@ MXU-native layout — the NCHW Torch-parity layout makes XLA insert
 relayout ops around every conv).  Timing syncs via a host transfer of
 the loss each window — on this backend ``block_until_ready`` alone does
 not guarantee completion.
+
+Resilience (ref models/utils/DistriOptimizerPerf.scala:32-90 is the
+analog harness; the retry contract is ours): the TPU backend behind the
+tunnel can be transiently UNAVAILABLE or hang outright during init/first
+compile.  Each measurement attempt therefore runs in a *fresh
+subprocess* under a hard wall-clock timeout; the supervisor retries with
+backoff and, if every attempt fails, emits a structured JSON error line
+so the driver records *why* instead of a bare rc=1.
 """
 from __future__ import annotations
 
 import json
 import os
+import signal
+import subprocess
+import sys
 import time
 
-import numpy as np
+# ---------------------------------------------------------------------------
+# Supervisor: retry/backoff around a subprocess per attempt.
+# ---------------------------------------------------------------------------
 
+_RETRYABLE_MARKERS = (
+    "UNAVAILABLE",
+    "JaxRuntimeError",
+    "Unable to initialize backend",
+    "DEADLINE_EXCEEDED",
+    "INTERNAL",
+    "Socket closed",
+    "failed to connect",
+    "ABORTED",
+)
+
+
+def _tpu_holder_diagnostic() -> str:
+    """Report processes that look like stale TPU holders (the wedge the
+    README warns about: a dead trainer keeps the chip claimed and every
+    new backend init returns UNAVAILABLE until it is reaped)."""
+    notes = []
+    lockfile = "/tmp/libtpu_lockfile"
+    if os.path.exists(lockfile):
+        notes.append(f"{lockfile} exists")
+    me = os.getpid()
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
+            except OSError:
+                continue
+            if not cmd:
+                continue
+            try:
+                maps = open(f"/proc/{pid}/maps", "r", errors="replace").read()
+            except OSError:
+                continue
+            if "libtpu" in maps or "accel" in maps:
+                notes.append(f"pid {pid} holds libtpu: {cmd[:120]}")
+    except OSError:
+        pass
+    return "; ".join(notes) if notes else "no stale TPU holder found"
+
+
+def _supervise() -> int:
+    attempts = int(os.environ.get("BIGDL_TPU_BENCH_ATTEMPTS", "5"))
+    timeout = float(os.environ.get("BIGDL_TPU_BENCH_TIMEOUT", "900"))
+    backoff = 5.0
+    last_tail = ""
+    for attempt in range(1, attempts + 1):
+        env = dict(os.environ)
+        env["BIGDL_TPU_BENCH_INNER"] = "1"
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=timeout)
+            rc, out, err = proc.returncode, proc.stdout, proc.stderr
+        except subprocess.TimeoutExpired as e:
+            rc = -signal.SIGKILL
+            out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+            err = f"attempt timed out after {timeout:.0f}s (backend hang)"
+        dt = time.time() - t0
+        # success: pass through the result JSON line (last parseable line)
+        if rc == 0:
+            for line in reversed(out.strip().splitlines()):
+                try:
+                    parsed = json.loads(line)
+                except (json.JSONDecodeError, ValueError):
+                    continue
+                if isinstance(parsed, dict) and "metric" in parsed:
+                    print(line)
+                    return 0
+            err = err + "\nno JSON result line in output"
+        last_tail = (err or out)[-2000:]
+        retryable = (rc != 0 and (
+            any(m in last_tail for m in _RETRYABLE_MARKERS)
+            or "timed out" in last_tail
+            or rc < 0))
+        print(f"bench: attempt {attempt}/{attempts} failed after {dt:.0f}s "
+              f"(rc={rc}, retryable={retryable})", file=sys.stderr)
+        print(last_tail, file=sys.stderr)
+        if not retryable and rc != 0:
+            break  # deterministic failure (bug): retrying won't help
+        if attempt < attempts:
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 60.0)
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+        "value": None,
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "error": last_tail[-600:],
+        "tpu_diagnostic": _tpu_holder_diagnostic(),
+        "attempts": attempts,
+    }))
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# Inner: one measurement attempt (fresh process).
+# ---------------------------------------------------------------------------
 
 def main() -> None:
-    import sys
-
     env_batch = os.environ.get("BIGDL_TPU_BENCH_BATCH")
-    candidates = ([int(env_batch)] if env_batch else [256, 128])
+    candidates = ([int(env_batch)] if env_batch else [512, 256, 128])
     last_err = None
     for batch in candidates:
         try:
@@ -48,6 +160,7 @@ def main() -> None:
 def _run(batch: int) -> None:
     import jax
     import jax.numpy as jnp
+    import numpy as np
     from bigdl_tpu import nn
     from bigdl_tpu.models import ResNet
     from bigdl_tpu.optim import SGD
@@ -106,8 +219,12 @@ def _run(batch: int) -> None:
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / baseline, 4),
+        "batch": batch,
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BIGDL_TPU_BENCH_INNER"):
+        main()
+    else:
+        sys.exit(_supervise())

@@ -1,15 +1,17 @@
 """Long-context attention benchmark: the flash kernels' memory claim,
-measured (VERDICT r1: a 16k-token causal TRAIN step must fit where a
-full-score-matrix backward cannot).
+measured (VERDICT r1/r2: prove the Pallas kernels on hardware).
 
     python -m bigdl_tpu.models.utils.attention_bench -t 16384
-    python -m bigdl_tpu.models.utils.attention_bench -t 4096 --naive
+    python -m bigdl_tpu.models.utils.attention_bench \
+        --sweep 2048,8192,16384,32768 --naive --json BENCH_ATTN.json
 
-Prints one JSON line per run: step time for a causal flash-attention
-forward+backward at (B, H, T, D), and — with ``--naive`` — the same for
-the O(T^2) XLA attention so the crossover is visible.  On a TPU the
-naive path runs out of HBM orders of magnitude before the flash path
-does; both paths share the bf16 qkv inputs.
+Prints one JSON line per (impl, T): causal train-step time (fwd+bwd) at
+(B, H, T, D); ``--naive`` also times the O(T^2) XLA attention so the
+crossover is visible.  ``--sweep`` writes every row plus the per-T
+flash/XLA speedup into one JSON document for committing.  A config that
+OOMs or fails to compile reports {"error": ...} instead of killing the
+sweep — on a TPU the naive path runs out of HBM orders of magnitude
+before the flash path does; both paths share the bf16 qkv inputs.
 """
 from __future__ import annotations
 
@@ -32,48 +34,87 @@ def _step_time(fn, q, k, v, iters: int = 5) -> float:
     return (time.perf_counter() - t0) / iters
 
 
-def main(argv=None) -> None:
-    p = argparse.ArgumentParser(description="Flash-attention train-step bench")
-    p.add_argument("-t", "--seqLen", type=int, default=16384)
-    p.add_argument("-b", "--batch", type=int, default=1)
-    p.add_argument("--heads", type=int, default=8)
-    p.add_argument("--headDim", type=int, default=128)
-    p.add_argument("--dtype", default="bfloat16",
-                   choices=["bfloat16", "float32"])
-    p.add_argument("--naive", action="store_true",
-                   help="also time the O(T^2) XLA attention")
-    args = p.parse_args(argv)
-
+def bench_one(impl: str, seq_len: int, batch: int, heads: int,
+              head_dim: int, dtype: str, iters: int = 5) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
     from bigdl_tpu.nn.attention import dot_product_attention
     from bigdl_tpu.ops import flash_attention
 
-    dt = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
     rng = np.random.RandomState(0)
-    shape = (args.batch, args.heads, args.seqLen, args.headDim)
+    shape = (batch, heads, seq_len, head_dim)
     q = jnp.asarray(rng.randn(*shape), dt)
     k = jnp.asarray(rng.randn(*shape), dt)
     v = jnp.asarray(rng.randn(*shape), dt)
+    fn = (lambda q, k, v: flash_attention(q, k, v, causal=True)) \
+        if impl == "flash" else \
+        (lambda q, k, v: dot_product_attention(q, k, v, causal=True))
+    row = {"metric": "flash_causal_train_step", "impl": impl,
+           "seq_len": seq_len, "batch": batch, "heads": heads,
+           "head_dim": head_dim, "dtype": dtype}
+    try:
+        step_s = _step_time(fn, q, k, v, iters=iters)
+        row["step_s"] = round(step_s, 5)
+        row["tokens_per_s"] = round(batch * seq_len / step_s, 1)
+    except Exception as e:  # OOM / compile failure: report, keep sweeping
+        row["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+    return row
 
-    flash_s = _step_time(
-        lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
-    tokens_s = args.batch * args.seqLen / flash_s
-    print(json.dumps({"metric": "flash_causal_train_step", "impl": "flash",
-                      "seq_len": args.seqLen, "batch": args.batch,
-                      "heads": args.heads, "head_dim": args.headDim,
-                      "dtype": args.dtype, "step_s": round(flash_s, 5),
-                      "tokens_per_s": round(tokens_s, 1)}))
-    if args.naive:
-        naive_s = _step_time(
-            lambda q, k, v: dot_product_attention(q, k, v, causal=True),
-            q, k, v)
-        print(json.dumps({"metric": "flash_causal_train_step",
-                          "impl": "naive_xla", "seq_len": args.seqLen,
-                          "step_s": round(naive_s, 5),
-                          "tokens_per_s": round(
-                              args.batch * args.seqLen / naive_s, 1)}))
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Flash-attention train-step bench")
+    p.add_argument("-t", "--seqLen", type=int, default=16384)
+    p.add_argument("--sweep", default=None,
+                   help="comma-separated seq lens; overrides --seqLen")
+    p.add_argument("-b", "--batch", type=int, default=1)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--headDim", type=int, default=128)
+    p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--dtype", default="bfloat16",
+                   choices=["bfloat16", "float32"])
+    p.add_argument("--naive", action="store_true",
+                   help="also time the O(T^2) XLA attention")
+    p.add_argument("--json", default=None,
+                   help="write the full sweep to this path")
+    args = p.parse_args(argv)
+
+    import jax
+
+    seq_lens = ([int(s) for s in args.sweep.split(",")]
+                if args.sweep else [args.seqLen])
+    rows = []
+    for t in seq_lens:
+        for impl in (["flash", "naive_xla"] if args.naive else ["flash"]):
+            row = bench_one("flash" if impl == "flash" else "naive",
+                            t, args.batch, args.heads, args.headDim,
+                            args.dtype, iters=args.iters)
+            row["impl"] = impl
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    result = {"platform": jax.devices()[0].platform,
+              "device": str(jax.devices()[0]), "rows": rows}
+    # per-T crossover summary
+    by_t = {}
+    for r in rows:
+        by_t.setdefault(r["seq_len"], {})[r["impl"]] = r
+    summary = []
+    for t in sorted(by_t):
+        pair = by_t[t]
+        entry = {"seq_len": t}
+        f, n = pair.get("flash"), pair.get("naive_xla")
+        if f and "step_s" in f and n and "step_s" in n:
+            entry["flash_speedup_vs_xla"] = round(n["step_s"] / f["step_s"], 3)
+        elif f and "step_s" in f and n and "error" in n:
+            entry["flash_speedup_vs_xla"] = "inf (xla failed: OOM-class)"
+        summary.append(entry)
+    if summary:
+        result["summary"] = summary
+    if args.json:
+        from bigdl_tpu.utils import fs
+        fs.atomic_write(args.json,
+                        (json.dumps(result, indent=2) + "\n").encode())
 
 
 if __name__ == "__main__":

@@ -84,6 +84,9 @@ def run_lm_perf(seq_len: int, batch: int, *, vocab: int = 32000,
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="TransformerLM train throughput")
     p.add_argument("-t", "--seqLen", type=int, default=2048)
+    p.add_argument("--sweep", default=None,
+                   help="comma-separated seq lens (each timed flash AND "
+                        "xla attention); overrides --seqLen/--flash")
     p.add_argument("-b", "--batch", type=int, default=8)
     p.add_argument("--vocab", type=int, default=32000)
     p.add_argument("--hidden", type=int, default=512)
@@ -97,12 +100,40 @@ def main(argv=None) -> None:
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("-i", "--iteration", type=int, default=10)
+    p.add_argument("--json", default=None,
+                   help="write the sweep result document to this path")
     args = p.parse_args(argv)
-    print(json.dumps(run_lm_perf(
-        args.seqLen, args.batch, vocab=args.vocab, hidden=args.hidden,
-        heads=args.heads, layers=args.layers, flash=args.flash,
-        remat=args.remat, optim=args.optim, dtype=args.dtype,
-        iters=args.iteration)))
+    if not args.sweep:
+        print(json.dumps(run_lm_perf(
+            args.seqLen, args.batch, vocab=args.vocab, hidden=args.hidden,
+            heads=args.heads, layers=args.layers, flash=args.flash,
+            remat=args.remat, optim=args.optim, dtype=args.dtype,
+            iters=args.iteration)))
+        return
+
+    import jax
+    rows = []
+    for t in (int(s) for s in args.sweep.split(",")):
+        for flash in (True, False):
+            row = {"seq_len": t, "flash": flash}
+            try:
+                # long T at fixed batch would OOM the naive path first;
+                # keep tokens/step constant by shrinking batch
+                eff_batch = max(1, args.batch * args.seqLen // t)
+                row = run_lm_perf(
+                    t, eff_batch, vocab=args.vocab, hidden=args.hidden,
+                    heads=args.heads, layers=args.layers, flash=flash,
+                    remat=args.remat, optim=args.optim, dtype=args.dtype,
+                    iters=args.iteration)
+            except Exception as e:
+                row["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    result = {"platform": jax.devices()[0].platform, "rows": rows}
+    if args.json:
+        from bigdl_tpu.utils import fs
+        fs.atomic_write(args.json,
+                        (json.dumps(result, indent=2) + "\n").encode())
 
 
 if __name__ == "__main__":

@@ -6,7 +6,7 @@ so ``from bigdl_tpu import nn; nn.Linear(...)`` mirrors
 """
 from bigdl_tpu.nn.module import Module, Criterion
 from bigdl_tpu.nn.containers import (
-    Container, Sequential, Concat, ConcatTable, ParallelTable, MapTable,
+    Container, Sequential, Concat, DepthConcat, ConcatTable, ParallelTable, MapTable,
     Bottle, FlattenTable, SplitTable, JoinTable, MixtureTable, NarrowTable,
     SelectTable,
 )

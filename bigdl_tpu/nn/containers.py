@@ -141,6 +141,40 @@ class Concat(Container):
         return jnp.concatenate(outs, axis=axis), new_buffers
 
 
+class DepthConcat(Concat):
+    """Concat along the channel dim with spatial zero-padding to the
+    largest branch output (torch nn.DepthConcat; the GoogLeNet-era
+    building block whose branches emit different spatial sizes — the
+    reference has no analog, it sizes its inception branches to match).
+    Odd size differences pad like torch: the extra row/column goes after
+    the centered map."""
+
+    def __init__(self, *modules: Module):
+        super().__init__(2, *modules)
+
+    def apply(self, params, x, *, buffers=None, training=False, rng=None):
+        buffers = buffers or {}
+        outs, new_buffers = [], {}
+        for i in range(len(self.modules)):
+            y, b = self._child_apply(i, params, x, buffers, training, rng)
+            outs.append(y)
+            new_buffers[str(i)] = b
+        spatial_axes = list(range(2, outs[0].ndim))
+        if spatial_axes:
+            targets = [max(o.shape[a] for o in outs) for a in spatial_axes]
+            padded = []
+            for o in outs:
+                widths = [(0, 0)] * o.ndim
+                for a, t in zip(spatial_axes, targets):
+                    lead = (t - o.shape[a]) // 2
+                    widths[a] = (lead, t - o.shape[a] - lead)
+                padded.append(jnp.pad(o, widths) if any(
+                    w != (0, 0) for w in widths) else o)
+            outs = padded
+        axis = to_axis(self.dimension, outs[0].ndim)
+        return jnp.concatenate(outs, axis=axis), new_buffers
+
+
 class ConcatTable(Container):
     """Apply every child to the same input; collect outputs into a Table
     (ref nn/ConcatTable.scala)."""

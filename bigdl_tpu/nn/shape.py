@@ -177,6 +177,7 @@ class Padding(Module):
         self.pad = pad
         self.n_input_dim = n_input_dim
         self.value = value
+        self.n_index = n_index
 
     def f(self, params, x, **kw):
         nid = self.n_input_dim if self.n_input_dim > 0 else None

@@ -2,9 +2,10 @@
 
 The reference distinguishes local AtomicDouble counters from Spark
 accumulators aggregated on the driver; here a metric is local to the
-process, and in a multi-host job each host reports its own (cross-host
-aggregation of *training* statistics rides the same collectives as
-gradients, so there is no separate accumulator RPC to build).
+process, and ``aggregate()`` plays the Spark-accumulator role in a
+multi-host job: every process contributes its counters and receives the
+cross-process mean (a host-side allgather over DCN — cheap, called at
+summary points only, and collective: every process must call it).
 """
 from __future__ import annotations
 
@@ -16,6 +17,28 @@ class Metrics:
         self._values: dict[str, float] = {}
         self._counts: dict[str, int] = {}
         self._lock = threading.Lock()
+
+    def aggregate(self) -> "Metrics":
+        """Cross-process mean of every counter (ref Metrics.scala:24-112:
+        Spark accumulators summed on the driver; here each process gets
+        the fleet view).  COLLECTIVE — in a multi-process job all
+        processes must call it together.  No-op single-process."""
+        import jax
+        if jax.process_count() <= 1:
+            return self
+        import numpy as np
+        from jax.experimental import multihost_utils
+        with self._lock:
+            names = sorted(self._values)
+            local = np.array([self._values[n] for n in names], np.float64)
+        gathered = np.asarray(multihost_utils.process_allgather(local))
+        mean = gathered.mean(axis=0) if gathered.ndim > 1 else gathered
+        out = Metrics()
+        with self._lock:
+            for i, n in enumerate(names):
+                out._values[n] = float(mean[i])
+                out._counts[n] = self._counts.get(n, 1)
+        return out
 
     def set(self, name: str, value: float, parallel: int = 1) -> None:
         with self._lock:

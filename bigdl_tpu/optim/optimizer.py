@@ -288,6 +288,13 @@ class Optimizer:
         fs layer, so gs://... checkpoint dirs work from pod workers (the
         reference's hdfs: support, utils/File.scala:62-122)."""
         from bigdl_tpu.utils import file_io, fs
+        if jax.process_index() != 0:
+            # every process publishes (the gathers above are collective),
+            # but only process 0 touches the filesystem — the reference's
+            # driver-writes-the-checkpoint contract
+            # (DistriOptimizer.scala:334-356) without N hosts racing on
+            # one gs:// path
+            return
         n = self.state["neval"] - 1
         self.model.save(fs.join(self.checkpoint_path, f"model.{n}"),
                         overwrite=True)

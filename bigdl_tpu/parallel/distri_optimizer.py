@@ -167,6 +167,24 @@ class DistriOptimizer(Optimizer):
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
+    @staticmethod
+    def _repad_flat_leaf(leaf, arp):
+        """Re-pad a checkpointed flat optimizer-state vector for the
+        current slot count.  Only 1-D leaves spanning the whole parameter
+        vector re-pad (moment buffers); scalars and anything else pass
+        through.  A leaf that cannot correspond to this model's parameter
+        size fails loudly instead of silently training on garbage."""
+        a = jnp.asarray(leaf)
+        if a.ndim != 1 or a.size == arp.padded_size:
+            return leaf
+        if a.size < arp.size:
+            raise ValueError(
+                f"restored optimizer state has a flat vector of size "
+                f"{a.size}, smaller than the model's parameter size "
+                f"{arp.size} — the checkpoint belongs to a different model")
+        trimmed = a[: arp.size]
+        return jnp.zeros((arp.padded_size,), a.dtype).at[: arp.size].set(trimmed)
+
     def _check_preemption(self) -> bool:
         """Multi-host preemption consensus: SIGTERM lands on ONE process;
         an unsynchronized flag would have the evicted host enter
@@ -216,9 +234,15 @@ class DistriOptimizer(Optimizer):
         w_shards = jax.device_put(w_shards, NamedSharding(self.mesh, P(DATA_AXIS)))
         # a restored snapshot continues where the checkpoint left off: the
         # published _state is the host view of the flat padded vector(s),
-        # which re-shards over the mesh exactly like a fresh init (a
-        # changed slot count fails loudly on the shape)
+        # which re-shards over the mesh exactly like a fresh init.  A
+        # checkpoint written under a different slot count has a different
+        # padding tail — trim each flat leaf back to the logical size and
+        # re-pad for this mesh (the tail is zeros by construction, so the
+        # reshard is exact; elastic restore across pod sizes just works)
         restored = getattr(self.optim_method, "_state", None)
+        if restored:
+            restored = jax.tree_util.tree_map(
+                lambda l: self._repad_flat_leaf(l, arp), restored)
         opt_state = restored if restored else self.optim_method.init_state(
             jnp.zeros((arp.padded_size,), jnp.float32))
         opt_state = jax.device_put(
@@ -254,10 +278,20 @@ class DistriOptimizer(Optimizer):
                 def sds(a):
                     a = jnp.asarray(a) if not isinstance(a, jax.Array) else a
                     try:
-                        return jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                                    sharding=a.sharding)
+                        sh = a.sharding
+                        # only pin mesh shardings: host-resident leaves
+                        # (e.g. BN buffers before their first update)
+                        # carry a single-device sharding that would make
+                        # lower() reject the mixed device sets jit itself
+                        # re-shards transparently
+                        if (isinstance(sh, NamedSharding)
+                                and sh.mesh.devices.shape
+                                == self.mesh.devices.shape):
+                            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                        sharding=sh)
                     except Exception:
-                        return jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        pass
+                    return jax.ShapeDtypeStruct(a.shape, a.dtype)
                 self._step_fn_ref = step_fn
                 self._step_avals = jax.tree_util.tree_map(
                     sds, (w_shards, opt_state, buffers, data, labels, sub,
@@ -340,7 +374,10 @@ class DistriOptimizer(Optimizer):
                 break
         self.state["records_processed"] = records_this_epoch
         log.info("training finished in %.1fs", time.perf_counter() - wall0)
-        log.info("phase breakdown: %s", self.metrics.summary())
+        # fleet-mean phase breakdown (ref Metrics' Spark accumulators
+        # aggregated on the driver) — safe as a collective here: every
+        # process exits the loop in lockstep (preemption is consensus'd)
+        log.info("phase breakdown: %s", self.metrics.aggregate().summary())
         self.model.params = arp.to_pytree(_fetch_to_host(w_shards))
         self.model.buffers = buffers
         # publish the final optimizer state too — without this, a run that
@@ -363,8 +400,16 @@ class DistriOptimizer(Optimizer):
             raise RuntimeError("run optimize() first — the footprint is "
                                "read from the compiled training step")
         from bigdl_tpu.utils import profiling
-        compiled = self._step_fn_ref.lower(*self._step_avals).compile()
-        self._footprint = profiling.collective_footprint(compiled.as_text())
+        lowered = self._step_fn_ref.lower(*self._step_avals)
+        if jax.devices()[0].platform == "cpu":
+            # the CPU backend legalizes bf16 collectives to f32 (no native
+            # bf16 on host), which would double-report the transport
+            # bytes; the pre-optimization program carries the dtypes that
+            # actually ride the wire on TPU
+            text = lowered.as_text(dialect="hlo")
+        else:
+            text = lowered.compile().as_text()
+        self._footprint = profiling.collective_footprint(text)
         return self._footprint
 
     def _validate(self):

@@ -96,20 +96,33 @@ class AllReduceParameter:
     # -- device-side cycle pieces (call inside shard_map) --------------- #
     def gather_weights(self, my_shard, axis: str = DATA_AXIS):
         """bf16 all-gather of weight slices -> full f32 flat vector
-        (ref getWeights :134-159)."""
-        gathered = lax.all_gather(my_shard.astype(self.transport_dtype),
-                                  axis, tiled=True)
-        return gathered.astype(jnp.float32)[: self.size]
+        (ref getWeights :134-159).
+
+        The optimization barrier pins the narrowing cast to the operand
+        side: without it XLA reassociates convert(all_gather(convert(x)))
+        into an f32 all-gather — same numerics (still rounded through
+        bf16), but double the wire bytes, silently defeating the fp16-
+        compression design the cycle exists to reproduce."""
+        compressed = lax.optimization_barrier(
+            my_shard.astype(self.transport_dtype))
+        gathered = lax.all_gather(compressed, axis, tiled=True)
+        # barrier on the result too: the widening convert otherwise hoists
+        # across the all-gather (elementwise ops commute with gathers) and
+        # the wire is back to f32
+        return lax.optimization_barrier(gathered).astype(jnp.float32)[: self.size]
 
     def scatter_gradients(self, grad_pytree, axis: str = DATA_AXIS,
                           mean: bool = True):
         """Flatten grads, bf16 reduce-scatter -> my owned f32 grad slice
-        (ref putGradients + aggregrateGradientPartition :161-215)."""
+        (ref putGradients + aggregrateGradientPartition :161-215).  The
+        barrier keeps the reduce-scatter in bf16 on the wire (see
+        gather_weights)."""
         flat, _ = ravel_pytree(grad_pytree)
         padded = jnp.zeros((self.padded_size,), flat.dtype).at[: self.size].set(flat)
-        scattered = lax.psum_scatter(padded.astype(self.transport_dtype),
-                                     axis, tiled=True)
-        out = scattered.astype(jnp.float32)
+        scattered = lax.psum_scatter(
+            lax.optimization_barrier(padded.astype(self.transport_dtype)),
+            axis, tiled=True)
+        out = lax.optimization_barrier(scattered).astype(jnp.float32)
         if mean:
             out = out / lax.psum(1, axis)
         return out

@@ -165,6 +165,49 @@ def _shape_bytes(shape_str: str) -> int:
     return total
 
 
+#: v5e ICI: ~45 GB/s per link per direction; ring collectives stream both
+#: directions of one axis concurrently, so ~90 GB/s effective per chip is
+#: the planning number (the "How to Scale Your Model" recipe: bytes moved /
+#: ICI bandwidth = collective time; bytes from the compiled program below).
+ICI_GBPS_DEFAULT = 90.0
+
+
+def wire_bytes(footprint: dict[str, int], n: int) -> float:
+    """Bytes a ring implementation actually moves per chip for the
+    collectives in a ``collective_footprint`` dict, on an ``n``-device
+    axis.  The footprint records bytes *produced* (HLO result shapes);
+    ring algorithms move:
+
+      all-gather:          out × (N-1)/N      (each chip receives the
+                                               other N-1 slices)
+      reduce-scatter:      in × (N-1)/N = out × (N-1)
+      all-reduce:          out × 2(N-1)/N     (reduce-scatter + all-gather)
+      collective-permute:  out                (one hop, all bytes)
+      all-to-all:          out × (N-1)/N
+
+    With the DP cycle (bf16 all-gather of weights + bf16 reduce-scatter of
+    grads, parameters/AllReduceParameter.scala's split) this comes to
+    2(N-1)/N x param-bytes — the classic ring all-reduce volume."""
+    if n <= 1:
+        return 0.0
+    factors = {"all-gather": (n - 1) / n, "reduce-scatter": float(n - 1),
+               "all-reduce": 2 * (n - 1) / n, "collective-permute": 1.0,
+               "all-to-all": (n - 1) / n}
+    return float(sum(bytes_ * factors.get(op, 1.0)
+                     for op, bytes_ in footprint.items()))
+
+
+def predict_ici_efficiency(compute_s: float, wire_bytes_per_chip: float,
+                           ici_gbps: float = ICI_GBPS_DEFAULT) -> dict:
+    """Roofline weak-scaling prediction: step(N) = compute + wire/ICI_BW
+    (no overlap assumed — a lower bound; XLA's latency-hiding scheduler
+    overlaps most of the all-gather with the forward pass in practice)."""
+    comm_s = wire_bytes_per_chip / (ici_gbps * 1e9)
+    step_s = compute_s + comm_s
+    return {"predicted_comm_s": comm_s, "predicted_step_s": step_s,
+            "predicted_efficiency": compute_s / step_s if step_s else 1.0}
+
+
 def collective_footprint(compiled_text: str) -> dict[str, int]:
     """Bytes produced per step by each collective family in an optimized
     HLO dump (``jitted.lower(...).compile().as_text()``).  The all-gather

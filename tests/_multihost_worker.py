@@ -1,35 +1,37 @@
-"""Worker for the simulated multi-host test (run as a subprocess).
+"""Worker for the simulated multi-host tests (run as a subprocess).
 
 usage: python tests/_multihost_worker.py <process_id> <num_processes> <port>
+           [scenario] [workdir]
 
 Each process owns 2 virtual CPU devices and its round-robin shard of the
 global dataset; the DistriOptimizer step assembles global batches with
 ``jax.make_array_from_process_local_data`` — the multi-host branch that
-has no coverage inside single-process pytest.  Prints one JSON line with
-the per-iteration losses (identical on every process: the loss is
-pmean'd across the mesh).
+has no coverage inside single-process pytest.  Prints one JSON line.
+
+Scenarios (the simulated-cluster strategy of the reference's
+DistriOptimizerSpec, optim/DistriOptimizerSpec.scala:39-43):
+  parity     3 iterations, report the final loss (default)
+  train_ckpt 4 iterations with a checkpoint every 2 — only process 0
+             writes files
+  resume     pick the newest checkpoint in <workdir> (possibly written
+             under a DIFFERENT process count: the flat optimizer state
+             re-pads for this mesh) and train 2 more iterations
+  preempt    slow iterations until SIGTERM lands on one process; the
+             cross-process consensus must stop every process cleanly
+             with a final checkpoint
 """
 import json
 import os
 import sys
+import time
 
 
-def main():
-    proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
-    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
-                               num_processes=nproc, process_id=proc_id)
-    assert jax.process_count() == nproc
-    assert jax.local_device_count() == 2
-
+def _build_job(nproc, workdir=None, slow=False):
     import numpy as np
 
     from bigdl_tpu import nn
     from bigdl_tpu.dataset.dataset import DistributedDataSet
-    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.dataset.transformer import SampleToBatch, Transformer
     from bigdl_tpu.dataset.types import Sample
     from bigdl_tpu.optim import SGD, Trigger
     from bigdl_tpu.parallel import DistriOptimizer
@@ -40,19 +42,88 @@ def main():
                for i in range(16)]
     ds = DistributedDataSet(records)
     ds.shuffle = lambda: None  # deterministic order for the parity check
-    local_batch = 8 // nproc
+    local_batch = max(1, 8 // nproc)
     pipeline = ds >> SampleToBatch(local_batch, drop_last=True)
+    if slow:
+        class SlowDown(Transformer):
+            def __call__(self, it):
+                for x in it:
+                    time.sleep(0.25)
+                    yield x
+        pipeline = pipeline >> SlowDown()
 
     model = nn.Sequential(nn.Linear(4, 4), nn.Tanh(),
                           nn.Linear(4, 2), nn.LogSoftMax())
     opt = DistriOptimizer(model, pipeline, nn.ClassNLLCriterion())
-    opt.set_optim_method(SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)) \
-       .set_end_when(Trigger.max_iteration(3))
+    method = SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+    return opt, method
 
-    opt.optimize()
-    print(json.dumps({"process": proc_id,
-                      "final_loss": float(opt.state["loss"]),
-                      "global_devices": jax.device_count()}))
+
+def main():
+    proc_id, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    scenario = sys.argv[4] if len(sys.argv) > 4 else "parity"
+    workdir = sys.argv[5] if len(sys.argv) > 5 else None
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nproc, process_id=proc_id)
+    assert jax.process_count() == nproc
+    assert jax.local_device_count() == 2
+
+    from bigdl_tpu.optim import SGD, Trigger
+
+    out = {"process": proc_id, "global_devices": jax.device_count()}
+
+    if scenario == "parity":
+        opt, method = _build_job(nproc)
+        opt.set_optim_method(method).set_end_when(Trigger.max_iteration(3))
+        opt.optimize()
+
+    elif scenario == "train_ckpt":
+        opt, method = _build_job(nproc)
+        opt.set_optim_method(method) \
+           .set_end_when(Trigger.max_iteration(4)) \
+           .set_checkpoint(workdir, Trigger.several_iteration(2))
+        opt.optimize()
+
+    elif scenario == "resume":
+        from bigdl_tpu import nn
+        from bigdl_tpu.models.utils import restore_optim_state
+        from bigdl_tpu.utils import file_io
+        found = file_io.latest_checkpoint(workdir)
+        assert found is not None, f"no checkpoint under {workdir}"
+        model_path, state_path = found[0], found[1]
+        opt, method = _build_job(nproc)
+        opt.model = nn.Module.load(model_path)
+        restore_optim_state(opt, method, state_path)
+        start_neval = opt.state["neval"]
+        out["resumed_from"] = start_neval
+        # max_iteration(m) runs while neval <= m: two more iterations
+        opt.set_optim_method(method) \
+           .set_end_when(Trigger.max_iteration(start_neval + 1))
+        opt.optimize()
+
+    elif scenario == "preempt":
+        opt, method = _build_job(nproc, slow=True)
+        opt.set_optim_method(method) \
+           .set_end_when(Trigger.max_iteration(100000)) \
+           .set_checkpoint(workdir, Trigger.several_iteration(100000)) \
+           .handle_preemption()
+        print(json.dumps({"process": proc_id, "ready": True}), flush=True)
+        opt.optimize()
+        # report the REAL signal state: only the SIGTERM'd process has
+        # _preempted set; its peer stops via the cross-process consensus
+        out["preempted"] = bool(getattr(opt, "_preempted", False))
+        out["stopped_early"] = opt.state["neval"] < 100000
+
+    else:
+        raise SystemExit(f"unknown scenario {scenario}")
+
+    out["final_loss"] = float(opt.state["loss"])
+    out["neval"] = int(opt.state["neval"])
+    print(json.dumps(out))
     jax.distributed.shutdown()
 
 

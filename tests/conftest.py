@@ -22,6 +22,33 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--full", action="store_true", default=False,
+        help="CI-full mode: run the slow tests too (multihost subprocess "
+             "jobs, exhaustive torch oracles)")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test, skipped unless --full or "
+        "BIGDL_TPU_FULL_TESTS=1 (driver windows need the default run "
+        "under ~8 minutes; full coverage stays one flag away)")
+
+
+def pytest_collection_modifyitems(config, items):
+    full = (config.getoption("--full")
+            or os.environ.get("BIGDL_TPU_FULL_TESTS") == "1"
+            or (config.getoption("-m") and "slow" in config.getoption("-m")))
+    if full:
+        return
+    skip = pytest.mark.skip(
+        reason="slow: run with --full or BIGDL_TPU_FULL_TESTS=1")
+    for item in items:
+        if "slow" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture(autouse=True)
 def _reset_engine():
     from bigdl_tpu.utils.engine import Engine

@@ -82,6 +82,7 @@ def test_batchnorm_layout_parity(nprng):
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_resnet_layout_parity_forward_and_grad(nprng):
     """Same params, same input -> same logits and same param gradients in
     both layouts (the NHWC model takes NHWC input)."""
@@ -113,6 +114,7 @@ def test_resnet_layout_parity_forward_and_grad(nprng):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_resnet_imagenet_nhwc_builds(nprng):
     m = ResNet(class_num=1000, depth=50, dataset="imagenet",
                data_format="NHWC").build(seed=1)
@@ -126,6 +128,7 @@ def test_resnet_imagenet_nhwc_builds(nprng):
     assert full.shape == (2, 1000)
 
 
+@pytest.mark.slow
 def test_vgg_cifar_layout_parity(nprng):
     from bigdl_tpu.models import VggForCifar10
     m_ref = VggForCifar10(10).build(seed=5)

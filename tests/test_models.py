@@ -44,6 +44,7 @@ class TestResNet:
         y = _forward(ResNet(1000, depth=18, dataset="imagenet"), (1, 3, 224, 224))
         assert y.shape == (1, 1000)
 
+    @pytest.mark.slow
     def test_imagenet_resnet50(self):
         m = ResNet(1000, depth=50, dataset="imagenet")
         y = _forward(m, (1, 3, 224, 224))
@@ -61,22 +62,26 @@ class TestVgg:
         y = _forward(VggForCifar10(10), (2, 3, 32, 32))
         assert y.shape == (2, 10)
 
+    @pytest.mark.slow
     def test_vgg16_imagenet(self):
         y = _forward(Vgg_16(1000), (1, 3, 224, 224))
         assert y.shape == (1, 1000)
 
 
 class TestInception:
+    @pytest.mark.slow
     def test_v1(self):
         y = _forward(Inception_v1(1000), (1, 3, 224, 224))
         assert y.shape == (1, 1000)
 
+    @pytest.mark.slow
     def test_v2(self):
         y = _forward(Inception_v2(1000), (1, 3, 224, 224))
         assert y.shape == (1, 1000)
 
 
 class TestAlexNet:
+    @pytest.mark.slow
     def test_forward(self):
         y = _forward(AlexNet(1000), (1, 3, 227, 227))
         assert y.shape == (1, 1000)
@@ -135,6 +140,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.shape == (8, 1000)
 
+    @pytest.mark.slow
     def test_dryrun_multichip(self):
         import __graft_entry__
         __graft_entry__.dryrun_multichip(8)

@@ -35,6 +35,7 @@ class TestTensorParallel:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_transformer_lm_tp_matches_unsharded(self):
         """Megatron sharding over the layer-stacked TransformerLM tree:
         sharded forward and grads match the replicated model."""
@@ -246,6 +247,7 @@ class TestHeteroPipeline:
         np.testing.assert_allclose(np.asarray(y_pipe), np.asarray(y_seq),
                                    rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_resnet_4stage_backward_matches_sequential(self, nprng):
         from bigdl_tpu.parallel import create_mesh
         from bigdl_tpu.parallel.mesh import PIPELINE_AXIS
@@ -339,6 +341,7 @@ class TestSparseMoE:
                                    rtol=1e-5, atol=1e-6)
         np.testing.assert_allclose(float(aux_c), float(aux_d), rtol=1e-6)
 
+    @pytest.mark.slow
     def test_tight_capacity_drops_overflow_tokens(self):
         from bigdl_tpu.parallel import create_mesh
         from bigdl_tpu.parallel.expert import moe_apply
@@ -359,6 +362,7 @@ class TestSparseMoE:
         assert np.all(cap_rows[~kept] == 0.0)
         assert dense_rows[~kept].sum() > 0  # they were real outputs before
 
+    @pytest.mark.slow
     def test_capacity_grads_flow(self):
         from bigdl_tpu.parallel import create_mesh
         from bigdl_tpu.parallel.expert import moe_apply

@@ -7,6 +7,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from bigdl_tpu import nn
 from bigdl_tpu.models import ResNet
@@ -74,6 +75,7 @@ def test_attribute_step_time_fills_get_times_from_jitted_run(nprng):
     assert per_layer["SpatialConvolution"] > per_layer["LogSoftMax"]
 
 
+@pytest.mark.slow
 def test_attribution_walks_nested_containers(nprng):
     m = ResNet(class_num=10, depth=8, dataset="cifar10").build(seed=1)
     x = jnp.asarray(nprng.randn(2, 3, 32, 32).astype(np.float32))
@@ -123,3 +125,54 @@ def test_shape_bytes_parser():
     assert profiling._shape_bytes("f32[128,1024]{1,0}") == 128 * 1024 * 4
     assert profiling._shape_bytes("bf16[8]") == 16
     assert profiling._shape_bytes("(f32[4], bf16[4])") == 16 + 8
+
+
+def test_collective_bytes_follow_ring_allreduce_law(nprng):
+    """VERDICT r2 #4: the DP cycle's wire volume must scale as
+    2(N-1)/N x param bytes (bf16 transport), the classic ring all-reduce
+    volume — all-gather of weights moves (N-1)/N x P, reduce-scatter of
+    gradients moves another (N-1)/N x P."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu import nn
+    from bigdl_tpu.dataset import DataSet, Sample
+    from bigdl_tpu.dataset.transformer import SampleToBatch
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.parallel import DistriOptimizer, create_mesh
+    from bigdl_tpu.parallel.mesh import DATA_AXIS
+    from bigdl_tpu.utils import profiling
+    from bigdl_tpu.utils.engine import ensure_virtual_devices
+
+    devices = ensure_virtual_devices(8)
+
+    def run(n):
+        mesh = create_mesh({DATA_AXIS: n}, devices=devices[:n])
+        model = nn.Sequential().add(nn.Linear(16, 32)).add(nn.ReLU()) \
+                               .add(nn.Linear(32, 4)).add(nn.LogSoftMax())
+        model.build(seed=1)
+        samples = [Sample(nprng.randn(16).astype(np.float32),
+                          np.asarray(float(i % 4) + 1, np.float32))
+                   for i in range(2 * n)]
+        ds = DataSet.array(samples) >> SampleToBatch(2 * n, drop_last=True)
+        opt = DistriOptimizer(model, ds, nn.ClassNLLCriterion(), mesh=mesh)
+        opt.set_optim_method(SGD(learning_rate=0.1)) \
+           .set_end_when(Trigger.max_iteration(1))
+        opt.optimize()
+        fp = opt.collective_footprint()
+        n_params = sum(np.asarray(l).size
+                       for l in jax.tree_util.tree_leaves(model.params))
+        return fp, n_params
+
+    for n in (2, 4):
+        fp, n_params = run(n)
+        # padded to the slot count; bf16 transport = 2 bytes/element
+        import math
+        padded = math.ceil(n_params / n) * n
+        expected_wire = 2 * (n - 1) / n * padded * 2
+        got_wire = profiling.wire_bytes(
+            {k: v for k, v in fp.items()
+             if k in ("all-gather", "reduce-scatter")}, n)
+        # scalar psums (loss/aux aggregation) ride along; the law must
+        # hold to within a small absolute slack for the param traffic
+        assert abs(got_wire - expected_wire) <= 0.02 * expected_wire + 256, \
+            (n, got_wire, expected_wire, fp)

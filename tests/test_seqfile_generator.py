@@ -49,3 +49,25 @@ def test_cli_main(image_tree, tmp_path_factory, capsys):
     main(["-f", image_tree, "-o", out, "-p", "1", "--splits", "val",
           "--validate"])
     assert "val: 4 records -> 1 shards" in capsys.readouterr().out
+
+
+def test_pipeline_bench_stream_shapes(tmp_path):
+    """The pipeline-fed bench's host path: shards -> threaded uint8
+    crop/flip -> prefetched NHWC uint8 batches (device normalize is the
+    step's job)."""
+    import numpy as np
+
+    import bigdl_tpu.models.utils.pipeline_bench as pb
+    crop, stored = pb.CROP, pb.STORED
+    pb.CROP, pb.STORED = 16, 24
+    try:
+        paths = pb.generate_shards(str(tmp_path), 32, n_shards=2)
+        stream = pb.batch_stream(paths, 8)
+        x, y = next(stream)
+        assert x.shape == (8, 16, 16, 3) and x.dtype == np.uint8
+        assert y.shape == (8,) and y.min() >= 1.0
+        for _ in range(8):  # crosses an epoch boundary (32 records / 8)
+            x, y = next(stream)
+        assert x.shape == (8, 16, 16, 3)
+    finally:
+        pb.CROP, pb.STORED = crop, stored

@@ -198,3 +198,188 @@ def test_reads_real_torch7_files():
             assert np.isfinite(a.astype(np.float64)).all()
         read += 1
     assert read > 0
+
+
+# --------------------------------------------------------------------- #
+# round-3 type breadth: the full reference dispatch set                 #
+# (TorchFile.scala:144-161 read, :257-290 write, + reflection fallback) #
+# --------------------------------------------------------------------- #
+
+def _roundtrip_module(m, tmp_path, name):
+    p = str(tmp_path / name)
+    save_model(m, p)
+    return load_model(p)
+
+
+def test_grouped_conv_roundtrip(tmp_path):
+    """Grouped conv exports as the Torch-readable Concat{Narrow, conv}
+    decomposition (standard Torch7 has no grouped SpatialConvolutionMM);
+    the re-import must be forward-equivalent."""
+    from bigdl_tpu import nn
+    m = nn.SpatialConvolution(4, 6, 3, 3, n_group=2).build(seed=3)
+    got = _roundtrip_module(m, tmp_path, "gconv.t7")
+    assert isinstance(got, nn.Concat)
+    assert len(got.modules) == 2  # one branch per group
+    x = np.random.RandomState(0).randn(2, 4, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(m.forward(x)),
+                               np.asarray(got.forward(x)), rtol=1e-5, atol=1e-5)
+
+
+def test_grouped_conv_import_with_ngroup_field(tmp_path):
+    """Import path for BigDL-written files that carry an nGroup element on
+    SpatialConvolutionMM (reference extension)."""
+    from bigdl_tpu import nn
+    src = nn.SpatialConvolution(4, 6, 3, 3, n_group=2).build(seed=3)
+    w2 = np.asarray(src.params["weight"], np.float32).reshape(6, -1)
+    obj = TorchObject("nn.SpatialConvolutionMM", {
+        "nInputPlane": 4.0, "nOutputPlane": 6.0, "kW": 3.0, "kH": 3.0,
+        "dW": 1.0, "dH": 1.0, "padW": 0.0, "padH": 0.0, "nGroup": 2.0,
+        "weight": w2, "bias": np.asarray(src.params["bias"], np.float32)})
+    got = t7.module_from_torch(obj)
+    assert isinstance(got, nn.SpatialConvolution) and got.n_group == 2
+    x = np.random.RandomState(0).randn(2, 4, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(src.forward(x)),
+                               np.asarray(got.forward(x)), rtol=1e-5, atol=1e-5)
+
+
+def test_depth_concat_roundtrip(tmp_path):
+    """DepthConcat pads branch spatial maps to the largest before the
+    channel concat (torch nn.DepthConcat semantics)."""
+    from bigdl_tpu import nn
+    m = nn.DepthConcat(
+        nn.SpatialConvolution(3, 2, 1, 1).build(seed=1),
+        nn.SpatialConvolution(3, 2, 3, 3).build(seed=2))
+    m.params = {str(i): c.params for i, c in enumerate(m.modules)}
+    m.buffers = {str(i): c.buffers for i, c in enumerate(m.modules)}
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    out = np.asarray(m.forward(x))
+    assert out.shape == (2, 4, 8, 8)  # 3x3 branch (6x6) zero-padded to 8x8
+    # padded border of the second branch's channels is exactly zero
+    np.testing.assert_array_equal(out[:, 2:, 0, :], 0.0)
+    got = _roundtrip_module(m, tmp_path, "dc.t7")
+    assert isinstance(got, nn.DepthConcat)
+    np.testing.assert_allclose(out, np.asarray(got.forward(x)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_conv_map_roundtrip(tmp_path):
+    from bigdl_tpu import nn
+    conn = nn.SpatialConvolutionMap.random(4, 3, 2, seed=7)
+    m = nn.SpatialConvolutionMap(conn, 3, 3).build(seed=5)
+    got = _roundtrip_module(m, tmp_path, "convmap.t7")
+    assert isinstance(got, nn.SpatialConvolutionMap)
+    x = np.random.RandomState(0).randn(2, 4, 8, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(m.forward(x)),
+                               np.asarray(got.forward(x)), rtol=1e-5, atol=1e-5)
+
+
+def test_full_and_dilated_conv_roundtrip(tmp_path):
+    from bigdl_tpu import nn
+    x = np.random.RandomState(0).randn(2, 4, 8, 8).astype(np.float32)
+    for name, m in [
+        ("full.t7", nn.SpatialFullConvolution(4, 2, 3, 3, 2, 2, 1, 1).build(seed=2)),
+        ("dila.t7", nn.SpatialDilatedConvolution(4, 2, 3, 3,
+                                                 dilation_w=2, dilation_h=2).build(seed=2)),
+    ]:
+        got = _roundtrip_module(m, tmp_path, name)
+        assert type(got) is type(m)
+        np.testing.assert_allclose(np.asarray(m.forward(x)),
+                                   np.asarray(got.forward(x)),
+                                   rtol=1e-5, atol=1e-5, err_msg=name)
+
+
+def test_parameterized_layer_roundtrips(tmp_path):
+    from bigdl_tpu import nn
+    vec = np.random.RandomState(0).randn(2, 6).astype(np.float32)
+    cases = [
+        (nn.LookupTable(10, 4).build(seed=1),
+         np.array([[1, 3], [9, 2]], np.float32)),
+        (nn.PReLU(6).build(seed=1), vec),
+        (nn.Mul().build(seed=1), vec),
+        (nn.Add(6).build(seed=1), vec),
+        (nn.CMul((1, 6)).build(seed=1), vec),
+        (nn.CAdd((1, 6)).build(seed=1), vec),
+        (nn.Euclidean(6, 3).build(seed=1), vec),
+    ]
+    for i, (m, x) in enumerate(cases):
+        got = _roundtrip_module(m, tmp_path, f"p{i}.t7")
+        assert type(got) is type(m)
+        np.testing.assert_allclose(
+            np.asarray(m.forward(x)), np.asarray(got.forward(x)),
+            rtol=1e-5, atol=1e-5, err_msg=type(m).__name__)
+
+
+def test_parameterless_layer_roundtrips(tmp_path):
+    """The reflection-fallback set: every parameter-free layer the reference
+    loads by class name (TorchFile.scala:163-177)."""
+    from bigdl_tpu import nn
+    vec = 0.25 * np.random.RandomState(0).randn(2, 6).astype(np.float32)
+    mods = [nn.Tanh(), nn.Sigmoid(), nn.SoftMax(), nn.SoftMin(),
+            nn.LogSoftMax(), nn.LogSigmoid(), nn.SoftSign(), nn.Abs(),
+            nn.Exp(), nn.Square(), nn.TanhShrink(), nn.Identity(),
+            nn.LeakyReLU(0.2), nn.ELU(0.7), nn.SoftPlus(2.0),
+            nn.HardTanh(-0.5, 0.5), nn.Clamp(-0.3, 0.3),
+            nn.Power(2.0, 1.5, 0.5), nn.MulConstant(3.0), nn.AddConstant(1.0),
+            nn.Mean(2), nn.Sum(2), nn.Max(2), nn.Min(2),
+            nn.Select(2, 3), nn.Narrow(2, 2, 3), nn.Replicate(3),
+            nn.Squeeze(), nn.Unsqueeze(2), nn.Normalize(2.0),
+            nn.Transpose([(1, 2)])]
+    for i, m in enumerate(mods):
+        m.build(seed=0)
+        got = _roundtrip_module(m, tmp_path, f"f{i}.t7")
+        # Clamp round-trips as its torch identity nn.HardTanh
+        assert isinstance(m, type(got)) or type(got) is type(m), type(m).__name__
+        np.testing.assert_allclose(
+            np.asarray(m.forward(vec)), np.asarray(got.forward(vec)),
+            rtol=1e-5, atol=1e-6, err_msg=type(m).__name__)
+
+
+def test_table_layer_roundtrips(tmp_path):
+    from bigdl_tpu import nn
+    a = np.random.RandomState(0).randn(2, 3).astype(np.float32)
+    b = np.random.RandomState(1).randn(2, 3).astype(np.float32)
+    for i, m in enumerate([nn.CAddTable(), nn.CSubTable(), nn.CMulTable(),
+                           nn.CDivTable(), nn.CMaxTable(), nn.CMinTable(),
+                           nn.JoinTable(2), nn.FlattenTable()]):
+        m.build(seed=0)
+        got = _roundtrip_module(m, tmp_path, f"t{i}.t7")
+        assert type(got) is type(m), type(m).__name__
+        out_a = m.forward([a, b])
+        out_b = got.forward([a, b])
+        np.testing.assert_allclose(np.asarray(out_a).ravel() if not isinstance(out_a, (list, tuple)) else np.concatenate([np.asarray(t).ravel() for t in out_a]),
+                                   np.asarray(out_b).ravel() if not isinstance(out_b, (list, tuple)) else np.concatenate([np.asarray(t).ravel() for t in out_b]),
+                                   rtol=1e-6, err_msg=type(m).__name__)
+
+
+def test_container_roundtrips(tmp_path):
+    from bigdl_tpu import nn
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    m = nn.Sequential()
+    m.add(nn.ConcatTable().add(nn.Linear(4, 3)).add(nn.Linear(4, 3)))
+    m.add(nn.CAddTable())
+    m.build(seed=9)
+    got = _roundtrip_module(m, tmp_path, "ct.t7")
+    np.testing.assert_allclose(np.asarray(m.forward(x)),
+                               np.asarray(got.forward(x)), rtol=1e-5, atol=1e-5)
+
+    pt = nn.ParallelTable(nn.Linear(4, 2), nn.Tanh()).build(seed=4)
+    got = _roundtrip_module(pt, tmp_path, "pt.t7")
+    outs_a = pt.forward([x, x])
+    outs_b = got.forward([x, x])
+    for oa, ob in zip(outs_a, outs_b):
+        np.testing.assert_allclose(np.asarray(oa), np.asarray(ob),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_lrn_and_avgpool_roundtrip(tmp_path):
+    from bigdl_tpu import nn
+    x = np.abs(np.random.RandomState(0).randn(2, 4, 6, 6)).astype(np.float32)
+    for i, m in enumerate([nn.SpatialCrossMapLRN(3, 0.5, 0.7, 1.2),
+                           nn.SpatialAveragePooling(2, 2, 2, 2),
+                           nn.SpatialZeroPadding(1, 2, 1, 0)]):
+        m.build(seed=0)
+        got = _roundtrip_module(m, tmp_path, f"l{i}.t7")
+        assert type(got) is type(m), type(m).__name__
+        np.testing.assert_allclose(np.asarray(m.forward(x)),
+                                   np.asarray(got.forward(x)),
+                                   rtol=1e-5, atol=1e-5, err_msg=type(m).__name__)

@@ -205,6 +205,7 @@ class TestRoPE:
             ids = np.concatenate([ids, nxt[:, None].astype(np.int32)], axis=1)
         np.testing.assert_array_equal(out, ids)
 
+    @pytest.mark.slow
     def test_rope_save_load_and_training(self, tmp_path):
         from bigdl_tpu.dataset import DataSet, Sample
         from bigdl_tpu.dataset.transformer import SampleToBatch
@@ -333,6 +334,7 @@ class TestMoELM:
 
 
 class TestSequenceParallelLM:
+    @pytest.mark.slow
     def test_ring_lm_matches_local(self):
         """Sequence-parallel forward (ring attention per block) matches
         the single-device model, loss and grads, on a data x seq mesh."""
@@ -372,6 +374,7 @@ class TestSequenceParallelLM:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-4, rtol=2e-3)
 
+    @pytest.mark.slow
     def test_ring_lm_pure_sequence_mesh(self):
         """The default data_axis=None works on a mesh with ONLY a
         sequence axis — the module's headline long-context shape."""
@@ -460,6 +463,7 @@ class TestGeneration:
         return TransformerLM(vocab_size=13, hidden_size=16, n_head=2,
                              n_layers=2, max_len=24).build(seed=7)
 
+    @pytest.mark.slow
     def test_greedy_matches_full_recompute(self):
         """KV-cached decode must equal the naive argmax loop that re-runs
         the whole model per token."""
@@ -499,6 +503,7 @@ class TestGeneration:
         with pytest.raises(ValueError, match="max_len"):
             generate(m, m.params, jnp.ones((1, 20), jnp.float32), 10)
 
+    @pytest.mark.slow
     def test_memorized_sequence_completion(self):
         """Train to memorize one sequence; greedy decode completes it."""
         from bigdl_tpu.dataset import DataSet, Sample
@@ -523,6 +528,7 @@ class TestGeneration:
 
 
 class TestLmPerf:
+    @pytest.mark.slow
     def test_smoke(self):
         from bigdl_tpu.models.utils.lm_perf import run_lm_perf
 
@@ -533,6 +539,7 @@ class TestLmPerf:
 
 
 class TestTransformerClis:
+    @pytest.mark.slow
     def test_packed_train_then_test(self, tmp_path, capsys):
         """--packed trains on dense windows and evaluates on the SAME
         pipeline (a padded-pipeline eval of a packed-trained model would
@@ -554,6 +561,7 @@ class TestTransformerClis:
                      "-b", "4", "--seqLength", "16", "--packed"])
         assert "Perplexity" in capsys.readouterr().out
 
+    @pytest.mark.slow
     def test_train_then_test(self, tmp_path, capsys):
         from bigdl_tpu.models.transformer import test as t_test
         from bigdl_tpu.models.transformer import train as t_train
