@@ -204,7 +204,7 @@ def _run(batch: int) -> None:
         params, buffers, opt_state, loss = step(params, buffers, opt_state, x, y, rng)
     _ = float(loss)  # hard sync
 
-    iters = 20
+    iters = int(os.environ.get("BIGDL_TPU_BENCH_ITERS", "20"))
     t0 = time.perf_counter()
     for _ in range(iters):
         params, buffers, opt_state, loss = step(params, buffers, opt_state, x, y, rng)

@@ -33,7 +33,10 @@ def run_lm_perf(seq_len: int, batch: int, *, vocab: int = 32000,
     model = TransformerLM(
         vocab_size=vocab, hidden_size=hidden, n_head=heads, n_layers=layers,
         max_len=seq_len, remat=remat,
-        attention_impl="flash" if flash else "auto").build(seed=1)
+        # pin the baseline arm to the XLA path: "auto" would itself pick
+        # flash at long T on TPU, turning the flash-vs-xla sweep into
+        # flash-vs-flash exactly where the crossover matters
+        attention_impl="flash" if flash else "xla").build(seed=1)
     crit = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
     method = (Adam(learning_rate=1e-3) if optim == "adam"
               else SGD(learning_rate=0.1))

@@ -137,11 +137,13 @@ class MultiHeadAttention(Module):
         self.causal = causal
         self.with_bias = with_bias
         self.block_size = block_size  # None -> plain fused attention
-        # "auto": plain/blockwise by block_size; "flash": the Pallas kernel
-        # (bigdl_tpu.ops.flash_attention) — the TPU hot path
-        if attention_impl not in ("auto", "flash"):
-            raise ValueError(f"attention_impl must be 'auto' or 'flash', "
-                             f"got {attention_impl!r}")
+        # "xla": always the fused XLA attention (required under GSPMD
+        # sharding rules — pallas_call only partitions inside shard_map);
+        # "flash": always the Pallas kernel; "auto": crossover dispatch —
+        # flash on TPU past FLASH_AUTO_MIN_T, XLA otherwise
+        if attention_impl not in ("auto", "flash", "xla"):
+            raise ValueError(f"attention_impl must be 'auto', 'flash' or "
+                             f"'xla', got {attention_impl!r}")
         self.attention_impl = attention_impl
 
     def init(self, rng):
@@ -195,7 +197,14 @@ class MultiHeadAttention(Module):
         else:
             q_in = k_in = v_in = x
         q, k, v = self.project_qkv(params, q_in, k_in, v_in)
-        if self.attention_impl == "flash":
+        use_flash = self.attention_impl == "flash"
+        if self.attention_impl == "auto" and not self.block_size:
+            # crossover dispatch: the Pallas kernel on TPU at long T,
+            # XLA's fused attention otherwise (ops.flash_attention.
+            # FLASH_AUTO_MIN_T, tunable from BENCH_ATTN measurements)
+            from bigdl_tpu.ops.flash_attention import use_flash_auto
+            use_flash = use_flash_auto(q.shape[-2])
+        if use_flash:
             from bigdl_tpu.ops import flash_attention
             bs = self.block_size or 128
             o = flash_attention(q, k, v, causal=self.causal,

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -415,6 +416,23 @@ def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, res, cts):
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
+
+
+#: sequence length at which MultiHeadAttention's "auto" mode switches
+#: from XLA's fused attention to the Pallas flash kernel on TPU.  Below
+#: the crossover XLA's single fused kernel wins (no pallas_call launch
+#: framing, and the (T,T) scores still fit VMEM-friendly fusions); above
+#: it the flash tiles win on HBM traffic and, past ~8-16k, are the only
+#: thing that fits at all.  Override with BIGDL_TPU_FLASH_MIN_T; pin from
+#: BENCH_ATTN.json measurements on the target chip generation.
+FLASH_AUTO_MIN_T = int(os.environ.get("BIGDL_TPU_FLASH_MIN_T", "4096"))
+
+
+def use_flash_auto(seq_len: int) -> bool:
+    """The "auto" dispatch rule: Pallas flash iff running on a real TPU
+    backend AND the sequence is past the crossover (interpreter-mode
+    flash on CPU is a correctness tool, never a speed win)."""
+    return jax.default_backend() == "tpu" and seq_len >= FLASH_AUTO_MIN_T
 
 
 def flash_attention(q, k, v, *, causal: bool = False,

@@ -20,7 +20,7 @@ from bigdl_tpu.parallel.sequence import (
 )
 from bigdl_tpu.parallel.tensor_parallel import (
     column_parallel_spec, row_parallel_spec, shard_params, mha_tp_rules,
-    mlp_tp_rules, transformer_lm_tp_rules, constrain_batch,
+    mlp_tp_rules, transformer_lm_tp_rules, constrain_batch, pin_xla_attention,
 )
 from bigdl_tpu.parallel.pipeline import pipeline_apply, pipeline_apply_local
 from bigdl_tpu.parallel.expert import init_moe_params, moe_apply, moe_apply_local

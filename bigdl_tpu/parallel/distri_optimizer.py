@@ -182,6 +182,16 @@ class DistriOptimizer(Optimizer):
                 f"restored optimizer state has a flat vector of size "
                 f"{a.size}, smaller than the model's parameter size "
                 f"{arp.size} — the checkpoint belongs to a different model")
+        # a genuine re-pad only ever trims the zero padding tail of the
+        # old slot count; nonzero values there mean a FOREIGN (larger)
+        # model's state — truncating would silently corrupt the moments
+        tail = np.asarray(a[arp.size:])
+        if tail.size and np.any(tail != 0):
+            raise ValueError(
+                f"restored optimizer state has {int(np.count_nonzero(tail))} "
+                f"nonzero values beyond the model's parameter size "
+                f"{arp.size} — the checkpoint belongs to a larger model, "
+                f"refusing to truncate it")
         trimmed = a[: arp.size]
         return jnp.zeros((arp.padded_size,), a.dtype).at[: arp.size].set(trimmed)
 

@@ -93,6 +93,24 @@ def constrain_batch(x, mesh: Mesh, axis: str = DATA_AXIS):
     return jax.lax.with_sharding_constraint(x, batch_sharding(mesh, x.ndim, axis))
 
 
+def pin_xla_attention(model) -> None:
+    """Force a model's attention onto the GSPMD-safe XLA path.  "auto"
+    would pick the Pallas flash kernel at long sequence lengths on TPU,
+    which does not partition under plain GSPMD sharding rules (only under
+    shard_map) — a TP-sharded step would fail to lower or silently gather
+    the sharded heads.  Call before jitting a TP step; "flash" raises
+    loudly rather than degrade."""
+    mha = getattr(model, "_mha", None) or getattr(model, "mha", None)
+    if mha is None:
+        return
+    if mha.attention_impl == "flash":
+        raise ValueError(
+            "attention_impl='flash' cannot be used under tensor-parallel "
+            "GSPMD rules (pallas_call partitions only under shard_map); "
+            "build the model with attention_impl='xla'")
+    mha.attention_impl = "xla"
+
+
 def transformer_lm_tp_rules(mesh: Mesh, axis: str = MODEL_AXIS):
     """Megatron sharding for ``models.transformer.TransformerLM``'s
     layer-STACKED parameter tree (every block leaf carries a leading
@@ -101,10 +119,13 @@ def transformer_lm_tp_rules(mesh: Mesh, axis: str = MODEL_AXIS):
     MLP w1 column / w2 row, embeddings/norms/head replicated.  One psum
     per attention block and one per MLP, inserted by XLA.
 
-    Use with the XLA attention path (``attention_impl="auto"``): GSPMD
+    Use with the XLA attention path (``attention_impl="xla"``): GSPMD
     partitions einsum attention over the sharded head dim by itself; the
     Pallas flash kernel partitions under ``shard_map`` instead (see
-    ``bigdl_tpu.parallel.sequence`` for that composition)."""
+    ``bigdl_tpu.parallel.sequence`` for that composition).  "auto" is NOT
+    shard-safe here — past the crossover length it would select the
+    flash kernel under GSPMD; ``pin_xla_attention(model)`` enforces the
+    right impl."""
 
     def rules(path, leaf):
         name = jax.tree_util.keystr(path)

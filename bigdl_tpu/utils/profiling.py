@@ -69,16 +69,29 @@ def record_layer_inputs(model: Module, x, training: bool = False,
     return records
 
 
-def _flops_of_compiled(compiled) -> float:
+#: v5e planning numbers for the roofline attribution: ~197 TFLOP/s bf16
+#: MXU peak, ~819 GB/s HBM bandwidth.  Only their RATIO matters for
+#: splitting a measured step across layers, so being a generation off
+#: shifts the split, not the total.
+PEAK_FLOPS = 197e12
+PEAK_HBM_BYTES_S = 819e9
+
+
+def _cost_of_compiled(compiled) -> tuple[float, float]:
+    """(flops, bytes accessed) of a compiled program, per XLA."""
     cost = compiled.cost_analysis()
     if isinstance(cost, (list, tuple)):  # one dict per device on old jax
         cost = cost[0]
-    return float(cost.get("flops", 0.0) or 0.0)
+    return (float(cost.get("flops", 0.0) or 0.0),
+            float(cost.get("bytes accessed", 0.0) or 0.0))
+
+
 
 
 def _layer_flops(child: Module, params, buffers, inp, training: bool,
                  include_train: bool = True):
-    """(forward flops, training flops) of one layer, per XLA."""
+    """(fwd flops, train flops, fwd bytes, train bytes) of one layer,
+    per XLA cost analysis."""
     rng = jax.random.PRNGKey(0)
 
     def fwd(p, i):
@@ -86,9 +99,9 @@ def _layer_flops(child: Module, params, buffers, inp, training: bool,
         return y
 
     lowered = jax.jit(fwd).lower(params, inp)
-    f_fwd = _flops_of_compiled(lowered.compile())
+    f_fwd, b_fwd = _cost_of_compiled(lowered.compile())
     if not include_train:
-        return f_fwd, f_fwd
+        return f_fwd, f_fwd, b_fwd, b_fwd
 
     def train(p, i):
         def scalar(pp):
@@ -101,10 +114,10 @@ def _layer_flops(child: Module, params, buffers, inp, training: bool,
 
     try:
         lowered_t = jax.jit(train).lower(params, inp)
-        f_train = _flops_of_compiled(lowered_t.compile())
+        f_train, b_train = _cost_of_compiled(lowered_t.compile())
     except Exception:
-        f_train = f_fwd  # non-differentiable layer: count forward only
-    return f_fwd, f_train
+        f_train, b_train = f_fwd, b_fwd  # non-differentiable: fwd only
+    return f_fwd, f_train, b_fwd, b_train
 
 
 def profile_layers(model: Module, x, training: bool = True,
@@ -120,34 +133,123 @@ def profile_layers(model: Module, x, training: bool = True,
         if getattr(child, "modules", None):
             continue  # containers: attributed via their leaves
         try:
-            f_fwd, f_train = _layer_flops(child, p, b, inp, training,
-                                          include_train=include_train)
+            f_fwd, f_train, b_fwd, b_train = _layer_flops(
+                child, p, b, inp, training, include_train=include_train)
         except Exception:
-            f_fwd = f_train = 0.0  # shape-only layers XLA folds away
+            f_fwd = f_train = b_fwd = b_train = 0.0  # XLA folds away
         rows.append({"module": child, "name": child.get_name(),
-                     "flops_fwd": f_fwd, "flops_train": f_train})
+                     "flops_fwd": f_fwd, "flops_train": f_train,
+                     "bytes_fwd": b_fwd, "bytes_train": b_train})
     return rows
 
 
 def attribute_step_time(model: Module, x, step_time_s: float,
-                        training: bool = True) -> list[dict]:
-    """Distribute a measured fused-step wall time over layers by their
-    compiled training flops, and write the result into each layer's
-    ``forward_time``/``backward_time`` so ``get_times()`` — the reference's
-    per-module timing API — reports per-layer cost from a *jitted* run."""
+                        training: bool = True,
+                        mode: str = "roofline") -> list[dict]:
+    """Distribute a measured fused-step wall time over layers and write
+    the result into each layer's ``forward_time``/``backward_time`` so
+    ``get_times()`` — the reference's per-module timing API — reports
+    per-layer cost from a *jitted* run.
+
+    ``mode="roofline"`` (default) weighs each layer by
+    max(flops/PEAK_FLOPS, bytes/PEAK_HBM_BYTES_S) — a bandwidth-bound
+    BatchNorm or transpose is billed for its HBM traffic instead of its
+    ~0 flops (which the old flop-share split mis-billed to the convs).
+    ``mode="flops"`` keeps the pure flop-proportional split.  Each row
+    carries ``bound`` ("compute"/"memory") for roofline mode."""
+    if mode not in ("roofline", "flops"):
+        raise ValueError(f"mode must be 'roofline'|'flops', got {mode!r}")
     rows = profile_layers(model, x, training=training)
-    total = sum(r["flops_train"] for r in rows) or 1.0
+
+    def weight(flops, bytes_):
+        if mode == "flops":
+            return flops
+        return max(flops / PEAK_FLOPS, bytes_ / PEAK_HBM_BYTES_S)
+
+    total = sum(weight(r["flops_train"], r["bytes_train"]) for r in rows) or 1.0
     for r in rows:
-        share = r["flops_train"] / total
-        t = share * step_time_s
-        # forward/backward split: forward flops vs the rest of the
-        # training flops (the backward ~2x forward rule falls out of the
-        # compiled numbers instead of being assumed)
-        fwd_frac = (r["flops_fwd"] / r["flops_train"]
-                    if r["flops_train"] > 0 else 1.0)
+        w = weight(r["flops_train"], r["bytes_train"])
+        t = (w / total) * step_time_s
+        if mode == "roofline":
+            r["bound"] = ("compute"
+                          if r["flops_train"] / PEAK_FLOPS
+                          >= r["bytes_train"] / PEAK_HBM_BYTES_S
+                          else "memory")
+        # forward/backward split from the compiled fwd vs train weights
+        # (the backward ~2x forward rule falls out of the numbers
+        # instead of being assumed)
+        w_fwd = weight(r["flops_fwd"], r["bytes_fwd"])
+        fwd_frac = min(w_fwd / w, 1.0) if w > 0 else 1.0
         r["time_s"] = t
-        r["module"].forward_time += t * min(fwd_frac, 1.0)
-        r["module"].backward_time += t * max(1.0 - fwd_frac, 0.0)
+        r["attribution"] = mode
+        r["module"].forward_time += t * fwd_frac
+        r["module"].backward_time += t * (1.0 - fwd_frac)
+    return rows
+
+
+def measure_layer_times(model: Module, x, training: bool = True,
+                        iters: int = 10, warmup: int = 2) -> list[dict]:
+    """ACTUAL wall time per layer, measured by executing each leaf layer's
+    compiled forward (and, when differentiable, value-and-grad) standalone
+    on the current backend (ref nn/abstractnn/AbstractModule.scala:125-135
+    accumulates real per-module time the same way, because the reference
+    executes layer by layer).
+
+    Honest caveat, stated in the row ("granularity": "standalone"): in the
+    real training step XLA fuses layers together, so standalone sums run
+    slower than the fused step — use these to RANK layers and find the
+    memory/compute balance, and ``attribute_step_time`` (roofline over the
+    measured fused step) for shares that add up to the real step time.
+    Results are also written into forward_time/backward_time."""
+    import time
+
+    records = record_layer_inputs(model, x, training=training)
+    rows = []
+    for parent, idx, child, inp, p, b in records:
+        if getattr(child, "modules", None):
+            continue
+        rng = jax.random.PRNGKey(0)
+
+        def fwd(pp, i):
+            y, _ = child.apply(pp, i, buffers=b, training=training, rng=rng)
+            return y
+
+        def train_fn(pp, i):
+            def scalar(q):
+                leaves = jax.tree_util.tree_leaves(fwd(q, i))
+                return sum(jnp.sum(jnp.asarray(l).astype(jnp.float32))
+                           for l in leaves)
+            return jax.value_and_grad(scalar)(pp)
+
+        def timed(fn):
+            try:
+                jitted = jax.jit(fn)
+                out = None
+                for _ in range(warmup):
+                    out = jitted(p, inp)
+                jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = jitted(p, inp)
+                jax.block_until_ready(out)
+                # host transfer: block_until_ready alone does not
+                # guarantee completion on every backend
+                _ = float(jnp.asarray(
+                    jax.tree_util.tree_leaves(out)[0]).ravel()[0])
+                return (time.perf_counter() - t0) / iters
+            except Exception:
+                return None
+
+        t_fwd = timed(fwd)
+        t_train = timed(train_fn) if training else t_fwd
+        row = {"module": child, "name": child.get_name(),
+               "measured_fwd_s": t_fwd, "measured_train_s": t_train,
+               "granularity": "standalone"}
+        rows.append(row)
+        if t_fwd is not None:
+            child.forward_time += t_fwd
+        if t_train is not None and t_fwd is not None:
+            child.backward_time += max(t_train - t_fwd, 0.0)
     return rows
 
 

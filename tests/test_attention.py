@@ -199,3 +199,15 @@ class TestRingFlash:
         for a, b in zip(gr, gp):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-4, rtol=1e-4)
+
+
+def test_auto_dispatch_rule():
+    """"auto" picks flash only on a TPU backend past the crossover length
+    (interpreter-mode flash on CPU is for correctness tests, never speed)."""
+    from bigdl_tpu.ops.flash_attention import (FLASH_AUTO_MIN_T,
+                                               use_flash_auto)
+    # this test process runs on CPU: never flash regardless of length
+    assert use_flash_auto(FLASH_AUTO_MIN_T * 2) is False
+    assert use_flash_auto(16) is False
+    # the rule itself, backend-independent part
+    assert FLASH_AUTO_MIN_T > 0

@@ -169,3 +169,43 @@ class TestDistriOptimizer:
         batched = ds >> SampleToBatch(16)
         opt = Optimizer.create(nn.Linear(6, 2), batched, nn.MSECriterion())
         assert isinstance(opt, DistriOptimizer)
+
+
+def test_repad_refuses_foreign_larger_state():
+    """Elastic restore trims only the zero padding tail; nonzero values
+    past the model's parameter size mean a different (larger) model's
+    checkpoint and must refuse loudly."""
+    import jax.numpy as jnp
+    import pytest
+
+    from bigdl_tpu.parallel.distri_optimizer import DistriOptimizer
+    from bigdl_tpu.parallel.parameters import AllReduceParameter
+
+    params = {"w": jnp.zeros((10,))}
+    arp = AllReduceParameter(params, 4)  # size 10, padded 12
+    # genuine re-pad from a 3-slot run (padded 12 -> same) or 5-slot
+    ok = jnp.arange(10.0)
+    bigger_padded = jnp.concatenate([ok, jnp.zeros((5,))])  # old padding
+    out = DistriOptimizer._repad_flat_leaf(bigger_padded, arp)
+    assert out.shape == (12,)
+    np.testing.assert_array_equal(np.asarray(out[:10]), np.asarray(ok))
+    # foreign model: nonzero beyond the parameter size
+    foreign = jnp.concatenate([ok, jnp.ones((5,))])
+    with pytest.raises(ValueError, match="larger model"):
+        DistriOptimizer._repad_flat_leaf(foreign, arp)
+
+
+def test_pin_xla_attention_guard():
+    from bigdl_tpu.models import TransformerLM
+    from bigdl_tpu.parallel import pin_xla_attention
+    import pytest
+
+    m = TransformerLM(vocab_size=11, hidden_size=8, n_head=2, n_layers=1,
+                      max_len=4)
+    assert m._mha.attention_impl == "auto"
+    pin_xla_attention(m)
+    assert m._mha.attention_impl == "xla"
+    flash = TransformerLM(vocab_size=11, hidden_size=8, n_head=2,
+                          n_layers=1, max_len=4, attention_impl="flash")
+    with pytest.raises(ValueError, match="shard_map"):
+        pin_xla_attention(flash)
