@@ -48,54 +48,50 @@ _RETRYABLE_MARKERS = (
 
 
 def _tpu_holder_diagnostic() -> str:
-    """Report processes that look like stale TPU holders (the wedge the
-    README warns about: a dead trainer keeps the chip claimed and every
-    new backend init returns UNAVAILABLE until it is reaped)."""
-    notes = []
-    lockfile = "/tmp/libtpu_lockfile"
-    if os.path.exists(lockfile):
-        notes.append(f"{lockfile} exists")
-    me = os.getpid()
+    """Stale-chip report (the wedge the README warns about); the scan
+    lives on Engine so library users get it too."""
     try:
-        for pid in os.listdir("/proc"):
-            if not pid.isdigit() or int(pid) == me:
-                continue
-            try:
-                with open(f"/proc/{pid}/cmdline", "rb") as f:
-                    cmd = f.read().replace(b"\0", b" ").decode(errors="replace")
-            except OSError:
-                continue
-            if not cmd:
-                continue
-            try:
-                maps = open(f"/proc/{pid}/maps", "r", errors="replace").read()
-            except OSError:
-                continue
-            if "libtpu" in maps or "accel" in maps:
-                notes.append(f"pid {pid} holds libtpu: {cmd[:120]}")
-    except OSError:
-        pass
-    return "; ".join(notes) if notes else "no stale TPU holder found"
+        from bigdl_tpu.utils.engine import Engine
+        return Engine.diagnose_tpu()
+    except Exception as e:  # the diagnostic must never mask the bench error
+        return f"diagnostic unavailable: {e}"
 
 
 def _supervise() -> int:
     attempts = int(os.environ.get("BIGDL_TPU_BENCH_ATTEMPTS", "5"))
     timeout = float(os.environ.get("BIGDL_TPU_BENCH_TIMEOUT", "900"))
+    # global wall-clock budget: the driver running this script has its own
+    # window — the structured error line must land BEFORE that window
+    # closes, so the last attempt is truncated to the remaining budget
+    deadline = time.time() + float(
+        os.environ.get("BIGDL_TPU_BENCH_DEADLINE", "2700"))
     backoff = 5.0
     last_tail = ""
+    tried = 0
     for attempt in range(1, attempts + 1):
+        remaining = deadline - time.time()
+        if remaining < 30:
+            last_tail = (last_tail or "") + "\nglobal deadline exhausted"
+            break
+        tried = attempt
         env = dict(os.environ)
         env["BIGDL_TPU_BENCH_INNER"] = "1"
+        if env.get("BIGDL_TPU_BENCH_XLA_FLAGS"):
+            # experiment hook: extra XLA flags for the measurement
+            # process only (e.g. latency-hiding scheduler variants)
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " "
+                                + env["BIGDL_TPU_BENCH_XLA_FLAGS"]).strip()
         t0 = time.time()
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True, timeout=timeout)
+                env=env, capture_output=True, text=True,
+                timeout=min(timeout, remaining))
             rc, out, err = proc.returncode, proc.stdout, proc.stderr
         except subprocess.TimeoutExpired as e:
             rc = -signal.SIGKILL
             out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
-            err = f"attempt timed out after {timeout:.0f}s (backend hang)"
+            err = f"attempt timed out after {min(timeout, remaining):.0f}s (backend hang)"
         dt = time.time() - t0
         # success: pass through the result JSON line (last parseable line)
         if rc == 0:
@@ -128,7 +124,7 @@ def _supervise() -> int:
         "vs_baseline": None,
         "error": last_tail[-600:],
         "tpu_diagnostic": _tpu_holder_diagnostic(),
-        "attempts": attempts,
+        "attempts": tried,
     }))
     return 1
 

@@ -179,7 +179,7 @@ class TransformerLM(Module):
         q, k, v = mha.project_qkv(bp["attn"], a, a, a)
         if positions is not None:
             q, k = self._rope(q, k, positions)
-        if mha.attention_impl == "flash":
+        if mha.resolve_use_flash(q.shape[-2]):
             from bigdl_tpu.ops import flash_attention
             bs = mha.block_size or 128
             o = flash_attention(q, k, v, causal=True, block_q=bs, block_k=bs)

@@ -66,10 +66,11 @@ def _prefill(model, params, ids0, cache_len):
     def body(h, bp):
         q, k, v = _block_qkv(model, bp, h)
         q, k = model._rope(q, k, positions)
-        # honor the model's configured attention core: flash keeps the
-        # (T, T) matrix out of HBM for long prompts, exactly as in
-        # TransformerLM._block
-        if model._mha.attention_impl == "flash":
+        # honor the model's configured attention core via the shared
+        # resolver (flash keeps the (T, T) matrix out of HBM for long
+        # prompts, exactly as in TransformerLM._block — including the
+        # "auto" crossover rule)
+        if model._mha.resolve_use_flash(q.shape[-2]):
             from bigdl_tpu.ops import flash_attention
             bs = model._mha.block_size or 128
             o = flash_attention(q, k, v, causal=True, block_q=bs,

@@ -35,7 +35,8 @@ def _step_time(fn, q, k, v, iters: int = 5) -> float:
 
 
 def bench_one(impl: str, seq_len: int, batch: int, heads: int,
-              head_dim: int, dtype: str, iters: int = 5) -> dict:
+              head_dim: int, dtype: str, iters: int = 5,
+              block_q: int = 128, block_k: int = 128) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
@@ -48,12 +49,14 @@ def bench_one(impl: str, seq_len: int, batch: int, heads: int,
     q = jnp.asarray(rng.randn(*shape), dt)
     k = jnp.asarray(rng.randn(*shape), dt)
     v = jnp.asarray(rng.randn(*shape), dt)
-    fn = (lambda q, k, v: flash_attention(q, k, v, causal=True)) \
+    fn = (lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                          block_q=block_q, block_k=block_k)) \
         if impl == "flash" else \
         (lambda q, k, v: dot_product_attention(q, k, v, causal=True))
     row = {"metric": "flash_causal_train_step", "impl": impl,
            "seq_len": seq_len, "batch": batch, "heads": heads,
-           "head_dim": head_dim, "dtype": dtype}
+           "head_dim": head_dim, "dtype": dtype,
+           "block_q": block_q, "block_k": block_k}
     try:
         step_s = _step_time(fn, q, k, v, iters=iters)
         row["step_s"] = round(step_s, 5)
@@ -72,6 +75,10 @@ def main(argv=None) -> None:
     p.add_argument("--heads", type=int, default=8)
     p.add_argument("--headDim", type=int, default=128)
     p.add_argument("--iters", type=int, default=5)
+    p.add_argument("--blockQ", type=int, default=128,
+                   help="flash query tile (sweep on hardware: 128-512)")
+    p.add_argument("--blockK", type=int, default=128,
+                   help="flash key tile (sweep on hardware: 128-1024)")
     p.add_argument("--dtype", default="bfloat16",
                    choices=["bfloat16", "float32"])
     p.add_argument("--naive", action="store_true",
@@ -89,7 +96,8 @@ def main(argv=None) -> None:
         for impl in (["flash", "naive_xla"] if args.naive else ["flash"]):
             row = bench_one("flash" if impl == "flash" else "naive",
                             t, args.batch, args.heads, args.headDim,
-                            args.dtype, iters=args.iters)
+                            args.dtype, iters=args.iters,
+                            block_q=args.blockQ, block_k=args.blockK)
             row["impl"] = impl
             rows.append(row)
             print(json.dumps(row), flush=True)

@@ -162,6 +162,18 @@ class MultiHeadAttention(Module):
                                     if name == "bo" else (inner,))
         return p
 
+    def resolve_use_flash(self, seq_len: int) -> bool:
+        """ONE dispatch rule for every call path (module forward,
+        TransformerLM block, generation prefill): explicit "flash" always;
+        "xla" never; "auto" by the TPU crossover — unless a block_size was
+        set, which pins the blockwise-XLA core."""
+        if self.attention_impl == "flash":
+            return True
+        if self.attention_impl == "auto" and not self.block_size:
+            from bigdl_tpu.ops.flash_attention import use_flash_auto
+            return use_flash_auto(seq_len)
+        return False
+
     def _split_heads(self, x):  # (B, T, H*D) -> (B, H, T, D)
         b, t, _ = x.shape
         return x.reshape(b, t, self.n_head, self.head_dim).transpose(0, 2, 1, 3)
@@ -197,14 +209,7 @@ class MultiHeadAttention(Module):
         else:
             q_in = k_in = v_in = x
         q, k, v = self.project_qkv(params, q_in, k_in, v_in)
-        use_flash = self.attention_impl == "flash"
-        if self.attention_impl == "auto" and not self.block_size:
-            # crossover dispatch: the Pallas kernel on TPU at long T,
-            # XLA's fused attention otherwise (ops.flash_attention.
-            # FLASH_AUTO_MIN_T, tunable from BENCH_ATTN measurements)
-            from bigdl_tpu.ops.flash_attention import use_flash_auto
-            use_flash = use_flash_auto(q.shape[-2])
-        if use_flash:
+        if self.resolve_use_flash(q.shape[-2]):
             from bigdl_tpu.ops import flash_attention
             bs = self.block_size or 128
             o = flash_attention(q, k, v, causal=self.causal,

@@ -60,10 +60,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(block_live)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        kb = k_ref[0, 0].astype(jnp.float32)
-        vb = v_ref[0, 0].astype(jnp.float32)
-        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)
+        # matmul operands stay in the INPUT dtype (bf16 runs the MXU at
+        # full rate; upcasting first would halve it) with f32
+        # accumulation via preferred_element_type; softmax math is f32
+        q = q_ref[0, 0]
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
         k_pos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_pos < tk_real
@@ -80,7 +83,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
-            p, vb, preferred_element_type=jnp.float32)
+            p.astype(vb.dtype), vb, preferred_element_type=jnp.float32)
         m_ref[:] = jnp.broadcast_to(m_new, (block_q, _LANES))
         l_ref[:] = jnp.broadcast_to(l_new, (block_q, _LANES))
 
@@ -184,10 +187,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(block_live)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)
-        kb = k_ref[0, 0].astype(jnp.float32)
-        vb = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 matmul operands + f32 accumulation (see _fwd_kernel)
+        q = q_ref[0, 0]
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, None]
         rest = (delta_ref[0, 0] - dlse_ref[0, 0])[:, None]
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
@@ -199,10 +203,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             mask = jnp.logical_and(mask, q_pos + (tk_real - tq_real) >= k_pos)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
-        dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv_acc[:] += jnp.dot(p.T.astype(do.dtype), do,
+                             preferred_element_type=jnp.float32)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - rest)
-        dk_acc[:] += jnp.dot(ds.T, q,
+        dk_acc[:] += jnp.dot(ds.T.astype(q.dtype), q,
                              preferred_element_type=jnp.float32) * scale
 
     @pl.when(iq == n_q - 1)
@@ -234,10 +239,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(block_live)
     def _():
-        q = q_ref[0, 0].astype(jnp.float32)
-        kb = k_ref[0, 0].astype(jnp.float32)
-        vb = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 matmul operands + f32 accumulation (see _fwd_kernel)
+        q = q_ref[0, 0]
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, None]
         rest = (delta_ref[0, 0] - dlse_ref[0, 0])[:, None]
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32) * scale
@@ -251,7 +257,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - rest)
-        dq_acc[:] += jnp.dot(ds, kb,
+        dq_acc[:] += jnp.dot(ds.astype(kb.dtype), kb,
                              preferred_element_type=jnp.float32) * scale
 
     @pl.when(j == n_k - 1)

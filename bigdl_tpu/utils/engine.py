@@ -272,6 +272,40 @@ class Engine:
             return True
 
     @staticmethod
+    def diagnose_tpu() -> str:
+        """Report processes that look like stale TPU holders — the wedge
+        where a dead trainer keeps the chip claimed and every new backend
+        init hangs or returns UNAVAILABLE until the holder is reaped
+        (the single-chip analog of the reference's checkSingleton guard:
+        utils/Engine.scala:164-174 prevents two tasks sharing an
+        executor; here two processes sharing a chip).  Pure /proc scan —
+        never touches the jax backend, so it is safe to call while the
+        chip is wedged."""
+        notes = []
+        lockfile = "/tmp/libtpu_lockfile"
+        if os.path.exists(lockfile):
+            notes.append(f"{lockfile} exists")
+        me = os.getpid()
+        try:
+            for pid in os.listdir("/proc"):
+                if not pid.isdigit() or int(pid) == me:
+                    continue
+                try:
+                    with open(f"/proc/{pid}/cmdline", "rb") as f:
+                        cmd = f.read().replace(b"\0", b" ").decode(
+                            errors="replace")
+                    with open(f"/proc/{pid}/maps", "r",
+                              errors="replace") as f:
+                        maps = f.read()
+                except OSError:
+                    continue
+                if cmd and ("libtpu" in maps or "accel" in maps):
+                    notes.append(f"pid {pid} holds libtpu: {cmd[:120]}")
+        except OSError:
+            pass
+        return "; ".join(notes) if notes else "no stale TPU holder found"
+
+    @staticmethod
     def reset() -> None:
         """Test hook: clear init + singleton state."""
         with _state.lock:

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Run the test suite as N parallel pytest processes, splitting by file
+# (the image has no pytest-xdist; test files are independent — each
+# process gets its own jax CPU backend and tmp dirs).
+#
+#     bash scripts/run_tests_sharded.sh            # default profile, N=3
+#     N=4 bash scripts/run_tests_sharded.sh --full # CI-full in 4 shards
+set -u
+cd "$(dirname "$0")/.."
+N=${N:-3}
+files=(tests/test_*.py)
+pids=()
+for i in $(seq 0 $((N - 1))); do
+  subset=()
+  for j in "${!files[@]}"; do
+    if [ $((j % N)) -eq "$i" ]; then subset+=("${files[$j]}"); fi
+  done
+  python -m pytest "${subset[@]}" -q "$@" &
+  pids+=($!)
+done
+rc=0
+for p in "${pids[@]}"; do
+  wait "$p" || rc=1
+done
+exit $rc

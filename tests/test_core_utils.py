@@ -104,3 +104,11 @@ class TestEngine:
     def test_require_init(self):
         with pytest.raises(RuntimeError):
             Engine.node_number()
+
+
+def test_engine_diagnose_tpu_smoke():
+    """The stale-chip scan must run without touching the jax backend and
+    return a human-readable report string."""
+    from bigdl_tpu.utils.engine import Engine
+    report = Engine.diagnose_tpu()
+    assert isinstance(report, str) and report

@@ -192,6 +192,7 @@ class TestExpertParallel:
                                    atol=1e-5, rtol=1e-5)
         assert float(aux) > 0.0
 
+    @pytest.mark.slow
     def test_2d_mesh_data_sharded_tokens(self):
         from bigdl_tpu.parallel.expert import init_moe_params, moe_apply
 
@@ -231,6 +232,7 @@ class TestHeteroPipeline:
         x = jnp.asarray(nprng.randn(8, 3, 32, 32).astype(np.float32))
         return m, x
 
+    @pytest.mark.slow
     def test_resnet_4stage_forward_matches_sequential(self, nprng):
         from bigdl_tpu.parallel import create_mesh
         from bigdl_tpu.parallel.mesh import PIPELINE_AXIS
@@ -327,6 +329,7 @@ class TestSparseMoE:
                         .astype(np.float32))
         return params, x
 
+    @pytest.mark.slow
     def test_full_capacity_matches_dense(self):
         from bigdl_tpu.parallel import create_mesh
         from bigdl_tpu.parallel.expert import moe_apply

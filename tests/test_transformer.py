@@ -80,6 +80,7 @@ class TestTransformerLM:
                                    np.asarray(y2)[:, :7], atol=1e-5)
         assert not np.allclose(np.asarray(y1)[:, 7:], np.asarray(y2)[:, 7:])
 
+    @pytest.mark.slow
     def test_remat_matches_plain(self):
         m1 = self._model(remat=False)
         m2 = self._model(remat=True)  # same seed -> same params
@@ -179,6 +180,7 @@ class TestRoPE:
         np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
                                    atol=1e-4)
 
+    @pytest.mark.slow
     def test_rope_ring_lm_matches_local(self):
         from bigdl_tpu.models.transformer.sp import ring_lm_apply
         from bigdl_tpu.parallel import create_mesh
@@ -192,6 +194,7 @@ class TestRoPE:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-4, rtol=2e-4)
 
+    @pytest.mark.slow
     def test_rope_generation_matches_full_recompute(self):
         from bigdl_tpu.models.transformer.generate import generate
 
@@ -270,6 +273,7 @@ class TestMoELM:
         _, nb2 = m2.apply(m2.params, x)
         assert "aux_loss" not in nb2
 
+    @pytest.mark.slow
     def test_trains_with_aux_through_optimizer(self):
         from bigdl_tpu.dataset import DataSet, Sample
         from bigdl_tpu.dataset.transformer import SampleToBatch
@@ -293,6 +297,7 @@ class TestMoELM:
             np.asarray(m.params["blocks"]["moe"]["gate"]),
             np.asarray(fresh.params["blocks"]["moe"]["gate"]))
 
+    @pytest.mark.slow
     def test_generation_matches_full_recompute(self):
         """Dense dispatch: per-token routing is batch-independent, so
         cached decode equals the full-recompute oracle exactly.  (With a
@@ -433,6 +438,7 @@ class TestSequenceParallelLM:
         with pytest.raises(ValueError, match="max_len"):
             ring_lm_apply(m2, m2.params, jnp.ones((2, 16)), mesh)
 
+    @pytest.mark.slow
     def test_ring_lm_honors_model_remat(self):
         """A remat-built model produces identical sp outputs (the block
         is checkpointed, not changed)."""
