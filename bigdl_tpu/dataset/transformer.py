@@ -105,24 +105,42 @@ class Prefetcher(Transformer):
 
     def __call__(self, it: Iterator) -> Iterator:
         q: queue.Queue = queue.Queue(maxsize=self.depth)
+        stop = threading.Event()
         _END = object()
         _ERR = object()
+
+        def put(x) -> bool:
+            # bounded-queue put that gives up when the consumer is gone —
+            # an abandoned prefetcher must stop doing work (a worker that
+            # keeps decoding into native code during interpreter shutdown
+            # crashes the process)
+            while not stop.is_set():
+                try:
+                    q.put(x, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for x in it:
-                    q.put(x)
+                    if not put(x):
+                        return
             except BaseException as e:  # noqa: BLE001 - re-raised in consumer
-                q.put((_ERR, e))
+                put((_ERR, e))
                 return
-            q.put(_END)
+            put(_END)
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            x = q.get()
-            if x is _END:
-                break
-            if isinstance(x, tuple) and len(x) == 2 and x[0] is _ERR:
-                raise x[1]
-            yield x
+        try:
+            while True:
+                x = q.get()
+                if x is _END:
+                    break
+                if isinstance(x, tuple) and len(x) == 2 and x[0] is _ERR:
+                    raise x[1]
+                yield x
+        finally:
+            stop.set()

@@ -57,9 +57,11 @@ def batch_stream(paths: list[str], batch: int, seed: int = 1,
                  n_threads: int = None, depth: int = 8):
     """shards -> threaded crop/flip -> uint8 NHWC batches, prefetched.
 
-    The thread pool plays MTLabeledBGRImgToBatch's role (per-image work
-    spread over Engine cores); the Prefetcher overlaps the whole host
-    stage with device steps."""
+    The crop/flip/pack hot loop runs in the native C++ batcher
+    (csrc bt_crop_flip_pack: std::thread + memcpy, the role the
+    reference's MTLabeledBGRImgToBatch threads play) with a Python
+    thread-pool fallback; the Prefetcher overlaps the whole host stage
+    with device steps."""
     from concurrent.futures import ThreadPoolExecutor
 
     from bigdl_tpu.dataset.seqfile import read_shard
@@ -68,6 +70,11 @@ def batch_stream(paths: list[str], batch: int, seed: int = 1,
     if n_threads is None:
         n_threads = max(4, (os.cpu_count() or 8) // 2)
     rng = np.random.RandomState(seed)
+    try:
+        from bigdl_tpu import native
+        lib = native.get()  # None -> python fallback; symbol set verified
+    except Exception:       # at load time by _set_prototypes
+        lib = None
 
     def decode_one(args):
         data, label, cy, cx, flip = args
@@ -77,9 +84,21 @@ def batch_stream(paths: list[str], batch: int, seed: int = 1,
             img = img[:, ::-1]
         return img, label
 
+    def emit(buf_args, pool):
+        y = np.asarray([a[1] for a in buf_args], np.float32)
+        if lib is not None:
+            x = lib.crop_flip_pack(
+                [a[0] for a in buf_args], STORED, STORED, CROP,
+                [a[2] for a in buf_args], [a[3] for a in buf_args],
+                [a[4] for a in buf_args], n_threads)
+            return x, y
+        out = list(pool.map(decode_one, buf_args, chunksize=8))
+        return np.stack([o[0] for o in out]), y
+
     def raw_batches():
-        pool = ThreadPoolExecutor(max_workers=n_threads,
-                                  thread_name_prefix="decode")
+        pool = (None if lib is not None else
+                ThreadPoolExecutor(max_workers=n_threads,
+                                   thread_name_prefix="decode"))
         try:
             while True:  # infinite epochs, reshuffled shard order
                 order = rng.permutation(len(paths))
@@ -92,14 +111,11 @@ def batch_stream(paths: list[str], batch: int, seed: int = 1,
                                          rng.randint(0, span + 1),
                                          bool(rng.randint(2))))
                         if len(buf_args) == batch:
-                            out = list(pool.map(decode_one, buf_args,
-                                                chunksize=8))
-                            x = np.stack([o[0] for o in out])
-                            y = np.asarray([o[1] for o in out], np.float32)
+                            yield emit(buf_args, pool)
                             buf_args = []
-                            yield x, y
         finally:
-            pool.shutdown(wait=False)
+            if pool is not None:
+                pool.shutdown(wait=False)
 
     return Prefetcher(depth)(raw_batches())
 

@@ -31,7 +31,7 @@ def _build() -> bool:
     tmp = _SO + f".tmp{os.getpid()}"
     try:
         subprocess.run(["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
-                        "-o", tmp, src],
+                        "-pthread", "-o", tmp, src],
                        check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
         return True
@@ -110,6 +110,12 @@ def _set_prototypes(dll: ctypes.CDLL) -> ctypes.CDLL:
                                         i64p, i64p,
                                         ctypes.POINTER(ctypes.c_float),
                                         ctypes.c_int64]
+    dll.bt_crop_flip_pack.restype = None
+    dll.bt_crop_flip_pack.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        u8p, u8p, ctypes.c_int32]
     dll.bt_tokenize.restype = ctypes.c_int64
     dll.bt_tokenize.argtypes = [ctypes.c_char_p, ctypes.c_int64, i64p, i64p,
                                 ctypes.c_int64]
@@ -196,6 +202,46 @@ class _Lib:
     def mt_set_state(self, handle, mt, mti, cached, has) -> None:
         arr = (ctypes.c_uint32 * 624)(*[int(x) & 0xFFFFFFFF for x in mt])
         self.dll.bt_mt_set_state(handle, arr, mti, cached, has)
+
+    # -- image batcher --------------------------------------------------- #
+    def crop_flip_pack(self, records, stored_h: int, stored_w: int,
+                       crop: int, cys, cxs, flips, n_threads: int = 0):
+        """Crop/flip/pack HWC uint8 image records into one (B, crop,
+        crop, 3) uint8 NHWC batch with native threads (the host hot loop
+        of the input pipeline; ref MTLabeledBGRImgToBatch.scala:52-80).
+        ``records``: list of bytes of size stored_h*stored_w*3 each."""
+        import numpy as np
+        batch = len(records)
+        want = stored_h * stored_w * 3
+        for i, r in enumerate(records):
+            if len(r) != want:
+                raise ValueError(
+                    f"record {i} has {len(r)} bytes, expected "
+                    f"{stored_h}x{stored_w}x3 = {want} (the native path "
+                    f"must keep the python path's shape guard — an "
+                    f"out-of-bounds read here is a segfault, not a "
+                    f"ValueError)")
+        out = np.empty((batch, crop, crop, 3), dtype=np.uint8)
+        recs = (ctypes.c_char_p * batch)(*records)
+        cy = np.ascontiguousarray(cys, dtype=np.int32)
+        cx = np.ascontiguousarray(cxs, dtype=np.int32)
+        fl = np.ascontiguousarray(flips, dtype=np.uint8)
+        if (cy.min(initial=0) < 0 or cx.min(initial=0) < 0
+                or cy.max(initial=0) + crop > stored_h
+                or cx.max(initial=0) + crop > stored_w):
+            raise ValueError("crop window out of bounds")
+        if n_threads <= 0:
+            n_threads = max(1, (os.cpu_count() or 8) // 2)
+        # tiny batches don't amortize thread spawn/join
+        n_threads = min(n_threads, max(1, batch // 8))
+        self.dll.bt_crop_flip_pack(
+            recs, batch, stored_h, stored_w, crop,
+            cy.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            cx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            fl.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            n_threads)
+        return out
 
     # -- shard indexing -------------------------------------------------- #
     def shard_index(self, buf, validate: bool = True):

@@ -16,6 +16,9 @@
 #include <cmath>
 #include <cstdlib>
 
+#include <thread>
+#include <vector>
+
 extern "C" {
 
 // --------------------------------------------------------------------- //
@@ -459,6 +462,57 @@ int64_t bt_tokenize(const uint8_t* s, int64_t len,
         i += cl;
     }
     return n;
+}
+
+
+// ---------------------------------------------------------------------
+// image batcher: crop/flip/pack HWC uint8 records into an NHWC batch
+// (the native hot loop behind models/utils/pipeline_bench.batch_stream;
+// the reference threads this work over Engine cores in
+// MTLabeledBGRImgToBatch.scala:52-80 — here it is std::thread + memcpy,
+// flips done per-pixel, everything stays uint8)
+// ---------------------------------------------------------------------
+void bt_crop_flip_pack(const uint8_t** recs, int64_t batch,
+                       int32_t stored_h, int32_t stored_w, int32_t crop,
+                       const int32_t* cy, const int32_t* cx,
+                       const uint8_t* flip, uint8_t* out,
+                       int32_t n_threads) {
+    if (n_threads < 1) n_threads = 1;
+    const int64_t out_img = (int64_t)crop * crop * 3;
+    auto work = [&](int64_t lo, int64_t hi) {
+        for (int64_t b = lo; b < hi; ++b) {
+            const uint8_t* src = recs[b];
+            uint8_t* dst = out + b * out_img;
+            for (int32_t r = 0; r < crop; ++r) {
+                const uint8_t* row =
+                    src + ((int64_t)(cy[b] + r) * stored_w + cx[b]) * 3;
+                uint8_t* drow = dst + (int64_t)r * crop * 3;
+                if (!flip[b]) {
+                    std::memcpy(drow, row, (size_t)crop * 3);
+                } else {
+                    for (int32_t cpx = 0; cpx < crop; ++cpx) {
+                        const uint8_t* px = row + (int64_t)(crop - 1 - cpx) * 3;
+                        drow[cpx * 3 + 0] = px[0];
+                        drow[cpx * 3 + 1] = px[1];
+                        drow[cpx * 3 + 2] = px[2];
+                    }
+                }
+            }
+        }
+    };
+    if (n_threads == 1 || batch < 2) {
+        work(0, batch);
+        return;
+    }
+    std::vector<std::thread> threads;
+    int64_t per = (batch + n_threads - 1) / n_threads;
+    for (int32_t t = 0; t < n_threads; ++t) {
+        int64_t lo = (int64_t)t * per;
+        int64_t hi = lo + per < batch ? lo + per : batch;
+        if (lo >= hi) break;
+        threads.emplace_back(work, lo, hi);
+    }
+    for (auto& th : threads) th.join();
 }
 
 }  // extern "C"

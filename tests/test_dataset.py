@@ -227,3 +227,33 @@ class TestSyntheticData:
     def test_cifar_synthetic(self):
         recs = cifar.synthetic(16)
         assert recs[0].data.shape == (3, 32, 32)
+
+
+def test_prefetcher_stops_worker_when_consumer_closes():
+    """An abandoned Prefetcher must stop its worker thread (a worker that
+    keeps producing into native code during interpreter shutdown
+    segfaults the process — round-3 regression)."""
+    import threading
+    import time
+
+    from bigdl_tpu.dataset.transformer import Prefetcher
+
+    produced = []
+    alive = threading.Event()
+
+    def source():
+        i = 0
+        while True:
+            produced.append(i)
+            alive.set()
+            yield i
+            i += 1
+
+    stream = Prefetcher(depth=2)(source())
+    assert next(stream) == 0
+    stream.close()  # consumer goes away
+    alive.clear()
+    time.sleep(0.5)  # worker has 0.1s poll interval; give it a few
+    count_after_close = len(produced)
+    time.sleep(0.5)
+    assert len(produced) == count_after_close, "worker kept producing"

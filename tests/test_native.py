@@ -208,3 +208,29 @@ class TestNativeTokenizer:
 
         toks = SentenceTokenizer().transform_one("The quick (brown) fox!")
         assert toks == ["the", "quick", "(", "brown", ")", "fox", "!"]
+
+
+def test_crop_flip_pack_matches_python():
+    """Native batcher (bt_crop_flip_pack) must byte-match the numpy
+    crop/flip path for both flipped and unflipped images."""
+    from bigdl_tpu import native
+    lib = native.get()
+    if lib is None:
+        import pytest
+        pytest.skip("native library unavailable")
+    rng = np.random.RandomState(0)
+    stored, crop, batch = 12, 8, 6
+    records = [rng.randint(0, 256, size=(stored, stored, 3),
+                           dtype=np.uint8) for _ in range(batch)]
+    cys = rng.randint(0, stored - crop + 1, size=batch)
+    cxs = rng.randint(0, stored - crop + 1, size=batch)
+    flips = rng.randint(0, 2, size=batch).astype(np.uint8)
+    got = lib.crop_flip_pack([r.tobytes() for r in records],
+                             stored, stored, crop, cys, cxs, flips,
+                             n_threads=3)
+    assert got.shape == (batch, crop, crop, 3) and got.dtype == np.uint8
+    for b in range(batch):
+        want = records[b][cys[b]:cys[b] + crop, cxs[b]:cxs[b] + crop]
+        if flips[b]:
+            want = want[:, ::-1]
+        np.testing.assert_array_equal(got[b], want)
