@@ -138,12 +138,17 @@ class DataSet:
 
     @staticmethod
     def record_files(paths: Sequence[str], distributed: bool = False) -> AbstractDataSet:
-        """Dataset over packed record shard files (the SequenceFile
-        equivalent, see bigdl_tpu.dataset.seqfile); records are the raw
-        (bytes, label) pairs."""
+        """Dataset over packed record files: the repo's own shard format
+        AND Hadoop SequenceFiles (``*.seq``, the reference's ImageNet
+        layout incl. record/block-compressed flavors) — per-file dispatch
+        on the name, so a reference-generated dataset and a TPU-native one
+        mix freely.  Records are the raw (bytes, label) pairs."""
+        from bigdl_tpu.dataset.hadoop_seqfile import file_records
         from bigdl_tpu.dataset.seqfile import read_shard
-        files = list(paths)
         all_records = []
-        for f in files:
-            all_records.extend(read_shard(f))
+        for f in list(paths):
+            if f.endswith(".seq"):
+                all_records.extend(file_records(f))
+            else:
+                all_records.extend(read_shard(f))
         return DataSet.array(all_records, distributed=distributed)

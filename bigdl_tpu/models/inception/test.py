@@ -30,7 +30,7 @@ def main(argv=None) -> None:
         ds = DataSet.record_files(val)
     ds = ds >> image.MTLabeledBGRImgToBatch(
         224, 224, args.batchSize,
-        image.BytesToBGRImg() >> image.BGRImgCropper(224, 224)
+        __import__('bigdl_tpu.dataset.hadoop_seqfile', fromlist=['AnyBytesToBGRImg']).AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
         >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
     model = nn.Module.load(args.model)
     for method, result in LocalValidator(model, ds).test(

@@ -68,6 +68,10 @@ def main(argv=None) -> None:
     from bigdl_tpu.optim.optim_method import Poly
 
     Engine.init()
+    # per-record decoder: encoded images AND reference .seq values both
+    # decode, so mixed folders work (hadoop_seqfile.AnyBytesToBGRImg)
+    from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
+    decode = AnyBytesToBGRImg()
     if args.synthetic:
         n = max(args.batchSize * 8, 64)
         train_ds = DataSet.array(_synthetic_records(n))
@@ -84,12 +88,12 @@ def main(argv=None) -> None:
     # ref ImageNet2012 pipeline: decode, random 224-crop + flip, normalize
     train_pipe = image.MTLabeledBGRImgToBatch(
         224, 224, args.batchSize,
-        image.BytesToBGRImg() >> image.BGRImgRdmCropper(224, 224)
+        decode >> image.BGRImgRdmCropper(224, 224)
         >> image.HFlip(0.5)
         >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
     val_pipe = image.MTLabeledBGRImgToBatch(
         224, 224, args.batchSize,
-        image.BytesToBGRImg() >> image.BGRImgCropper(224, 224)
+        decode.clone() >> image.BGRImgCropper(224, 224)
         >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
     train_ds = train_ds >> train_pipe
     val_ds = val_ds >> val_pipe

@@ -343,3 +343,82 @@ class TestCompressedSeqFile:
                             compression="record")
         got = SeqFileFolder.records(str(tmp_path))
         assert [r.label for r in got] == [1.0, 2.0, 3.0, 4.0]
+
+
+class TestSeqFolderTraining:
+    def test_inception_style_training_from_seq_folder(self, tmp_path):
+        """The reference's primary ImageNet path end-to-end: Hadoop .seq
+        folder -> record_files dispatch -> SeqBytesToBGRImg decode ->
+        crop/flip/normalize -> a few training iterations (tiny model
+        stand-in; the CLI wires the same pieces)."""
+        from bigdl_tpu import nn
+        from bigdl_tpu.dataset import DataSet, image
+        from bigdl_tpu.dataset.hadoop_seqfile import (SeqBytesToBGRImg,
+                                                      encode_bgr_image,
+                                                      write_sequence_file)
+        from bigdl_tpu.dataset.image import LabeledImage
+        from bigdl_tpu.optim import SGD, LocalOptimizer, Trigger
+
+        rng = np.random.RandomState(0)
+        records = []
+        for i in range(16):
+            img = LabeledImage(
+                rng.rand(3, 10, 10).astype(np.float32) * 255,
+                float(i % 2 + 1))
+            records.append((str(int(img.label)).encode(),
+                            encode_bgr_image(img.data)))
+        write_sequence_file(str(tmp_path / "train_0.seq"), records,
+                            compression="record")
+
+        ds = DataSet.record_files([str(tmp_path / "train_0.seq")])
+        pipe = (SeqBytesToBGRImg()
+                >> image.BGRImgCropper(8, 8)
+                >> image.BGRImgNormalizer((104.0, 117.0, 123.0),
+                                          (1.0, 1.0, 1.0))
+                >> image.BGRImgToBatch(8))
+        model = nn.Sequential(
+            nn.Reshape((3 * 8 * 8,)), nn.Linear(3 * 8 * 8, 2),
+            nn.LogSoftMax()).build(seed=1)
+        opt = LocalOptimizer(model, ds >> pipe, nn.ClassNLLCriterion())
+        opt.set_optim_method(SGD(learning_rate=0.01)) \
+           .set_end_when(Trigger.max_iteration(3))
+        opt.optimize()
+        assert np.isfinite(opt.state["loss"])
+
+    def test_mixed_native_and_seq_folder(self, tmp_path):
+        """A folder mixing the repo's shard flavor (encoded-image records)
+        with reference .seq shards (raw framed pixels) must decode
+        per-record through AnyBytesToBGRImg."""
+        import io as _io
+
+        from PIL import Image
+
+        from bigdl_tpu.dataset import DataSet, image
+        from bigdl_tpu.dataset.hadoop_seqfile import (AnyBytesToBGRImg,
+                                                      encode_bgr_image,
+                                                      write_sequence_file)
+        from bigdl_tpu.dataset.seqfile import write_shard
+        from bigdl_tpu.dataset.types import ByteRecord
+
+        rng = np.random.RandomState(0)
+        # native shard: PNG-encoded records
+        png_records = []
+        for i in range(3):
+            arr = rng.randint(0, 256, size=(10, 10, 3), dtype=np.uint8)
+            buf = _io.BytesIO()
+            Image.fromarray(arr).save(buf, format="PNG")
+            png_records.append(ByteRecord(buf.getvalue(), float(i + 1)))
+        write_shard(str(tmp_path / "train_a.shard"), png_records)
+        # reference shard: framed raw BGR
+        seq_records = [(b"1", encode_bgr_image(
+            rng.rand(3, 10, 10).astype(np.float32) * 255)) for _ in range(3)]
+        write_sequence_file(str(tmp_path / "train_b.seq"), seq_records)
+
+        ds = DataSet.record_files([str(tmp_path / "train_a.shard"),
+                                   str(tmp_path / "train_b.seq")])
+        pipe = AnyBytesToBGRImg() >> image.BGRImgCropper(8, 8)
+        imgs = list(pipe(ds.data(train=False)))
+        assert len(imgs) == 6
+        for im in imgs:
+            assert im.data.shape == (3, 8, 8)
+            assert np.isfinite(im.data).all()
