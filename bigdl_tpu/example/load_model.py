@@ -64,6 +64,7 @@ def load_model(args):
 
 
 def _dataset(args):
+    from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
     from bigdl_tpu.dataset import DataSet, image
 
     if args.dataset == "mnist":
@@ -85,7 +86,7 @@ def _dataset(args):
     val = [s for s in shards if "val" in os.path.basename(s)] or shards
     return DataSet.record_files(val) >> image.MTLabeledBGRImgToBatch(
         224, 224, args.batchSize,
-        __import__('bigdl_tpu.dataset.hadoop_seqfile', fromlist=['AnyBytesToBGRImg']).AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
+        AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
         >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
 
 

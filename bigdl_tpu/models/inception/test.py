@@ -17,6 +17,7 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO)
 
     from bigdl_tpu import Engine, nn
+    from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
     from bigdl_tpu.dataset import DataSet, image
     from bigdl_tpu.optim import LocalValidator, Top1Accuracy, Top5Accuracy
 
@@ -30,7 +31,7 @@ def main(argv=None) -> None:
         ds = DataSet.record_files(val)
     ds = ds >> image.MTLabeledBGRImgToBatch(
         224, 224, args.batchSize,
-        __import__('bigdl_tpu.dataset.hadoop_seqfile', fromlist=['AnyBytesToBGRImg']).AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
+        AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
         >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
     model = nn.Module.load(args.model)
     for method, result in LocalValidator(model, ds).test(

@@ -1,7 +1,11 @@
-"""ResNet CIFAR-10 training CLI (ref models/resnet/Train.scala).
+"""ResNet training CLI (ref models/resnet/Train.scala; the reference
+trains CIFAR-10 — the ImageNet dataset mode is the bench-config path,
+reading the same record/.seq shard folders as the Inception CLI).
 
     python -m bigdl_tpu.models.resnet.train -f /path/to/cifar --depth 20
     python -m bigdl_tpu.models.resnet.train --synthetic
+    python -m bigdl_tpu.models.resnet.train --dataset imagenet \\
+        -f /path/to/seq_shards --depth 50 --dataFormat NHWC
 """
 from __future__ import annotations
 
@@ -19,7 +23,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="checkpoint dir: resume from its newest model/state pair")
     p.add_argument("-b", "--batchSize", type=int, default=128)
     p.add_argument("-e", "--nepochs", type=int, default=165)
-    p.add_argument("--depth", type=int, default=20, help="6n+2 for cifar10")
+    p.add_argument("--depth", type=int, default=20,
+                   help="6n+2 for cifar10; 18/34/50/101/152 for imagenet")
+    p.add_argument("--dataset", default="cifar10",
+                   choices=["cifar10", "imagenet"])
+    p.add_argument("--classNumber", type=int, default=1000,
+                   help="imagenet mode only")
+    p.add_argument("--dataFormat", default="NCHW", choices=["NCHW", "NHWC"],
+                   help="NHWC = TPU-fast channels-last (imagenet mode)")
     p.add_argument("--shortcutType", default="A", choices=["A", "B", "C"])
     p.add_argument("-r", "--learningRate", type=float, default=0.1)
     p.add_argument("--weightDecay", type=float, default=1e-4)
@@ -42,29 +53,63 @@ def main(argv=None) -> None:
     from bigdl_tpu.optim.optim_method import EpochSchedule, Regime
 
     Engine.init()
-    if args.synthetic:
-        train_records, test_records = cifar.synthetic(2048), cifar.synthetic(512, seed=9)
+    if args.dataset == "imagenet":
+        if args.synthetic:
+            raise SystemExit("--synthetic is cifar-mode only; imagenet "
+                             "mode reads record/.seq shards from -f")
+        import glob
+        import os
+
+        from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
+        shards = sorted(glob.glob(os.path.join(args.folder, "*")))
+        train = [s for s in shards if "train" in os.path.basename(s)] or shards
+        val = [s for s in shards if "val" in os.path.basename(s)] or shards[:1]
+        train_ds = DataSet.record_files(train, distributed=args.distributed)
+        val_ds = DataSet.record_files(val)
+        train_ds = train_ds >> image.MTLabeledBGRImgToBatch(
+            224, 224, args.batchSize,
+            AnyBytesToBGRImg() >> image.BGRImgRdmCropper(224, 224)
+            >> image.HFlip(0.5)
+            >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
+        val_ds = val_ds >> image.MTLabeledBGRImgToBatch(
+            224, 224, args.batchSize,
+            AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
+            >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
+        model = nn.Module.load(args.model) if args.model else \
+            ResNet(args.classNumber, depth=args.depth,
+                   shortcut_type=args.shortcutType, dataset="imagenet",
+                   data_format=args.dataFormat).build(seed=1)
     else:
-        train_records = cifar.load(args.folder, train=True)
-        test_records = cifar.load(args.folder, train=False)
-    mean, std = cifar.TRAIN_MEAN, cifar.TRAIN_STD
+        if args.synthetic:
+            train_records, test_records = cifar.synthetic(2048), cifar.synthetic(512, seed=9)
+        else:
+            train_records = cifar.load(args.folder, train=True)
+            test_records = cifar.load(args.folder, train=False)
+        mean, std = cifar.TRAIN_MEAN, cifar.TRAIN_STD
 
-    # ref resnet training augmentation: pad-and-random-crop + flip; the
-    # loader yields 32x32 so random crop degenerates unless padded upstream
-    train_pipe = (image.HFlip(0.5)
-                  >> image.BGRImgNormalizer(mean, std)
-                  >> image.BGRImgToBatch(args.batchSize))
-    val_pipe = (image.BGRImgNormalizer(mean, std)
-                >> image.BGRImgToBatch(args.batchSize))
-    train_ds = DataSet.array(train_records, distributed=args.distributed) >> train_pipe
-    val_ds = DataSet.array(test_records) >> val_pipe
+        # ref resnet training augmentation: pad-and-random-crop + flip; the
+        # loader yields 32x32 so random crop degenerates unless padded upstream
+        train_pipe = (image.HFlip(0.5)
+                      >> image.BGRImgNormalizer(mean, std)
+                      >> image.BGRImgToBatch(args.batchSize))
+        val_pipe = (image.BGRImgNormalizer(mean, std)
+                    >> image.BGRImgToBatch(args.batchSize))
+        train_ds = DataSet.array(train_records, distributed=args.distributed) >> train_pipe
+        val_ds = DataSet.array(test_records) >> val_pipe
 
-    model = nn.Module.load(args.model) if args.model else \
-        ResNet(10, depth=args.depth, shortcut_type=args.shortcutType,
-               dataset="cifar10").build(seed=1)
-    # ref Train.scala cifar regime: lr, lr/10 after epoch 81, /100 after 122
-    schedule = EpochSchedule([Regime(1, 80, 1.0), Regime(81, 121, 0.1),
-                              Regime(122, 100000, 0.01)])
+        model = nn.Module.load(args.model) if args.model else \
+            ResNet(10, depth=args.depth, shortcut_type=args.shortcutType,
+                   dataset="cifar10").build(seed=1)
+    if args.dataset == "imagenet":
+        # classic ImageNet ResNet staircase: lr/10 at epochs 30, 60, 80
+        schedule = EpochSchedule([Regime(1, 29, 1.0), Regime(30, 59, 0.1),
+                                  Regime(60, 79, 0.01),
+                                  Regime(80, 100000, 0.001)])
+    else:
+        # ref Train.scala cifar regime: lr, lr/10 after epoch 81, /100
+        # after 122
+        schedule = EpochSchedule([Regime(1, 80, 1.0), Regime(81, 121, 0.1),
+                                  Regime(122, 100000, 0.01)])
     method = SGD(learning_rate=args.learningRate, weight_decay=args.weightDecay,
                  momentum=args.momentum, dampening=0.0, nesterov=True,
                  learning_rate_schedule=schedule)

@@ -84,9 +84,17 @@ class EpochDecay(LearningRateSchedule):
 
 
 class Regime:
-    def __init__(self, start_epoch: int, end_epoch: int, config: dict):
+    """Epoch range + config (ref SGD.Regime).  ``config`` is a dict with
+    "learning_rate" (absolute) or "learning_rate_multiplier" (scales the
+    method's base lr — the reference's Train.scala regimes express the
+    classic lr, lr/10, lr/100 staircase this way); a bare number is
+    shorthand for the multiplier form."""
+
+    def __init__(self, start_epoch: int, end_epoch: int, config):
         self.start_epoch = start_epoch
         self.end_epoch = end_epoch
+        if not isinstance(config, dict):
+            config = {"learning_rate_multiplier": float(config)}
         self.config = config
 
 
@@ -100,7 +108,11 @@ class EpochSchedule(LearningRateSchedule):
         lr = base_lr
         for r in self.regimes:
             in_regime = (epoch >= r.start_epoch) & (epoch <= r.end_epoch)
-            lr = jnp.where(in_regime, r.config.get("learning_rate", base_lr), lr)
+            if "learning_rate_multiplier" in r.config:
+                regime_lr = base_lr * r.config["learning_rate_multiplier"]
+            else:
+                regime_lr = r.config.get("learning_rate", base_lr)
+            lr = jnp.where(in_regime, regime_lr, lr)
         return lr
 
 

@@ -422,3 +422,39 @@ class TestSeqFolderTraining:
         for im in imgs:
             assert im.data.shape == (3, 8, 8)
             assert np.isfinite(im.data).all()
+
+
+class TestResnetCli:
+    def test_cifar_synthetic_one_iteration(self, tmp_path, monkeypatch):
+        """The resnet CLI end-to-end incl. the EpochSchedule multiplier
+        regimes (regression: float regimes crashed at the first LR
+        computation and no test drove this CLI)."""
+        from bigdl_tpu.models.resnet import train as cli
+
+        monkeypatch.setenv("BIGDL_TPU_PLATFORM", "cpu")
+        # tiny run: trim the synthetic dataset so one epoch is 2 batches
+        from bigdl_tpu.dataset import cifar
+        real_synth = cifar.synthetic
+        monkeypatch.setattr(cifar, "synthetic",
+                            lambda n, seed=1: real_synth(min(n, 64), seed=seed))
+        cli.main(["--synthetic", "-b", "32", "-e", "1", "--depth", "8"])
+
+    @pytest.mark.slow
+    def test_imagenet_seq_folder_one_iteration(self, tmp_path, monkeypatch):
+        """ResNet ImageNet mode reads the reference .seq layout (bench
+        config #3's training path)."""
+        from bigdl_tpu.dataset.hadoop_seqfile import (encode_bgr_image,
+                                                      write_sequence_file)
+        from bigdl_tpu.models.resnet import train as cli
+
+        monkeypatch.setenv("BIGDL_TPU_PLATFORM", "cpu")
+        rng = np.random.RandomState(0)
+        records = [(str(i % 4 + 1).encode(),
+                    encode_bgr_image((rng.rand(3, 256, 256) * 255)
+                                     .astype(np.float32)))
+                   for i in range(4)]
+        write_sequence_file(str(tmp_path / "train_0.seq"), records)
+        write_sequence_file(str(tmp_path / "val_0.seq"), records[:2])
+        cli.main(["--dataset", "imagenet", "-f", str(tmp_path),
+                  "--depth", "18", "--classNumber", "4", "-b", "2",
+                  "-e", "1"])
