@@ -257,6 +257,17 @@ class BGRImgToBatch(GreyImgToBatch):
     pass
 
 
+class BatchToNHWC(Transformer):
+    """MiniBatch (N,C,H,W) -> (N,H,W,C): feed channels-last models
+    (``data_format="NHWC"``, the MXU-native layout) from the NCHW image
+    pipeline without touching the model's param tree.  One host transpose
+    per batch; the conv-net CLIs insert it when ``--dataFormat NHWC``."""
+
+    def transform_one(self, b: MiniBatch) -> MiniBatch:
+        return MiniBatch(np.ascontiguousarray(b.data.transpose(0, 2, 3, 1)),
+                         b.labels)
+
+
 class _EnsureSize(Transformer):
     """Force (C, height, width): center-crop if larger, bilinear-resize
     otherwise.  Guarantees the static shape SampleToBatch (and XLA) needs."""

@@ -64,7 +64,7 @@ def load_model(args):
 
 
 def _dataset(args):
-    from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
+    from bigdl_tpu.models.utils import imagenet_val_pipe
     from bigdl_tpu.dataset import DataSet, image
 
     if args.dataset == "mnist":
@@ -84,10 +84,7 @@ def _dataset(args):
     import os
     shards = sorted(glob.glob(os.path.join(args.folder, "*")))
     val = [s for s in shards if "val" in os.path.basename(s)] or shards
-    return DataSet.record_files(val) >> image.MTLabeledBGRImgToBatch(
-        224, 224, args.batchSize,
-        AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
-        >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
+    return DataSet.record_files(val) >> imagenet_val_pipe(args.batchSize)
 
 
 def main(argv=None) -> None:

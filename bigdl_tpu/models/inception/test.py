@@ -17,7 +17,7 @@ def main(argv=None) -> None:
     logging.basicConfig(level=logging.INFO)
 
     from bigdl_tpu import Engine, nn
-    from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
+    from bigdl_tpu.models.utils import imagenet_val_pipe
     from bigdl_tpu.dataset import DataSet, image
     from bigdl_tpu.optim import LocalValidator, Top1Accuracy, Top5Accuracy
 
@@ -29,10 +29,7 @@ def main(argv=None) -> None:
         shards = sorted(glob.glob(os.path.join(args.folder, "*")))
         val = [s for s in shards if "val" in os.path.basename(s)] or shards
         ds = DataSet.record_files(val)
-    ds = ds >> image.MTLabeledBGRImgToBatch(
-        224, 224, args.batchSize,
-        AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
-        >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
+    ds = ds >> imagenet_val_pipe(args.batchSize)
     model = nn.Module.load(args.model)
     for method, result in LocalValidator(model, ds).test(
             [Top1Accuracy(), Top5Accuracy()]):

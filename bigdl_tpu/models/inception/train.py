@@ -68,35 +68,26 @@ def main(argv=None) -> None:
     from bigdl_tpu.optim.optim_method import Poly
 
     Engine.init()
-    # per-record decoder: encoded images AND reference .seq values both
-    # decode, so mixed folders work (hadoop_seqfile.AnyBytesToBGRImg)
-    from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
-    decode = AnyBytesToBGRImg()
     if args.synthetic:
+        from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
+        from bigdl_tpu.models.utils import (IMAGENET_BGR_MEAN,
+                                            IMAGENET_BGR_STD)
         n = max(args.batchSize * 8, 64)
         train_ds = DataSet.array(_synthetic_records(n))
         val_ds = DataSet.array(_synthetic_records(max(n // 4, 32), seed=9))
         class_num = 10
+        train_ds = train_ds >> image.MTLabeledBGRImgToBatch(
+            224, 224, args.batchSize,
+            AnyBytesToBGRImg() >> image.BGRImgRdmCropper(224, 224)
+            >> image.HFlip(0.5)
+            >> image.BGRImgNormalizer(IMAGENET_BGR_MEAN, IMAGENET_BGR_STD))
+        from bigdl_tpu.models.utils import imagenet_val_pipe
+        val_ds = val_ds >> imagenet_val_pipe(args.batchSize)
     else:
-        shards = sorted(glob.glob(os.path.join(args.folder, "*")))
-        train = [s for s in shards if "train" in os.path.basename(s)] or shards
-        val = [s for s in shards if "val" in os.path.basename(s)] or shards[:1]
-        train_ds = DataSet.record_files(train, distributed=args.distributed)
-        val_ds = DataSet.record_files(val)
+        from bigdl_tpu.models.utils import imagenet_seq_datasets
+        train_ds, val_ds = imagenet_seq_datasets(
+            args.folder, args.batchSize, distributed=args.distributed)
         class_num = args.classNumber
-
-    # ref ImageNet2012 pipeline: decode, random 224-crop + flip, normalize
-    train_pipe = image.MTLabeledBGRImgToBatch(
-        224, 224, args.batchSize,
-        decode >> image.BGRImgRdmCropper(224, 224)
-        >> image.HFlip(0.5)
-        >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
-    val_pipe = image.MTLabeledBGRImgToBatch(
-        224, 224, args.batchSize,
-        decode.clone() >> image.BGRImgCropper(224, 224)
-        >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
-    train_ds = train_ds >> train_pipe
-    val_ds = val_ds >> val_pipe
 
     factory = Inception_v1 if args.modelName == "inception_v1" else Inception_v2
     model = nn.Module.load(args.model) if args.model else \

@@ -57,24 +57,10 @@ def main(argv=None) -> None:
         if args.synthetic:
             raise SystemExit("--synthetic is cifar-mode only; imagenet "
                              "mode reads record/.seq shards from -f")
-        import glob
-        import os
-
-        from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
-        shards = sorted(glob.glob(os.path.join(args.folder, "*")))
-        train = [s for s in shards if "train" in os.path.basename(s)] or shards
-        val = [s for s in shards if "val" in os.path.basename(s)] or shards[:1]
-        train_ds = DataSet.record_files(train, distributed=args.distributed)
-        val_ds = DataSet.record_files(val)
-        train_ds = train_ds >> image.MTLabeledBGRImgToBatch(
-            224, 224, args.batchSize,
-            AnyBytesToBGRImg() >> image.BGRImgRdmCropper(224, 224)
-            >> image.HFlip(0.5)
-            >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
-        val_ds = val_ds >> image.MTLabeledBGRImgToBatch(
-            224, 224, args.batchSize,
-            AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
-            >> image.BGRImgNormalizer((104.0, 117.0, 123.0), (1.0, 1.0, 1.0)))
+        from bigdl_tpu.models.utils import imagenet_seq_datasets
+        train_ds, val_ds = imagenet_seq_datasets(
+            args.folder, args.batchSize, distributed=args.distributed,
+            data_format=args.dataFormat)
         model = nn.Module.load(args.model) if args.model else \
             ResNet(args.classNumber, depth=args.depth,
                    shortcut_type=args.shortcutType, dataset="imagenet",

@@ -114,3 +114,54 @@ def resolve_resume(args) -> None:
             "no checkpoints under %s yet: starting fresh", args.resume)
         return
     args.model, args.state = found[0], found[1]
+
+
+IMAGENET_BGR_MEAN = (104.0, 117.0, 123.0)
+IMAGENET_BGR_STD = (1.0, 1.0, 1.0)
+
+
+def imagenet_seq_datasets(folder: str, batch_size: int,
+                          distributed: bool = False,
+                          data_format: str = "NCHW"):
+    """The reference's ImageNet input path, shared by every conv-net CLI
+    (ref dataset/DataSet.scala:380-433 SeqFileFolder + ImageNet2012
+    pipeline): shard folder -> per-record decode (native shards or .seq)
+    -> 224 random-crop/flip (train) / center-crop (val) -> normalize ->
+    threaded batcher.  One definition so the four call sites (inception
+    train/test, resnet train, load_model) cannot drift.  Returns
+    (train_ds, val_ds)."""
+    import glob
+    import os
+
+    from bigdl_tpu.dataset import DataSet, image
+    from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
+
+    shards = sorted(glob.glob(os.path.join(folder, "*")))
+    train = [s for s in shards if "train" in os.path.basename(s)] or shards
+    val = [s for s in shards if "val" in os.path.basename(s)] or shards[:1]
+    train_ds = DataSet.record_files(train, distributed=distributed)
+    val_ds = DataSet.record_files(val)
+    train_pipe = image.MTLabeledBGRImgToBatch(
+        224, 224, batch_size,
+        AnyBytesToBGRImg() >> image.BGRImgRdmCropper(224, 224)
+        >> image.HFlip(0.5)
+        >> image.BGRImgNormalizer(IMAGENET_BGR_MEAN, IMAGENET_BGR_STD))
+    val_pipe = imagenet_val_pipe(batch_size)
+    train_ds = train_ds >> train_pipe
+    val_ds = val_ds >> val_pipe
+    if data_format == "NHWC":
+        train_ds = train_ds >> image.BatchToNHWC()
+        val_ds = val_ds >> image.BatchToNHWC()
+    return train_ds, val_ds
+
+
+def imagenet_val_pipe(batch_size: int):
+    """Center-crop evaluation pipeline (the half load_model/test CLIs
+    need on their own)."""
+    from bigdl_tpu.dataset import image
+    from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
+
+    return image.MTLabeledBGRImgToBatch(
+        224, 224, batch_size,
+        AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
+        >> image.BGRImgNormalizer(IMAGENET_BGR_MEAN, IMAGENET_BGR_STD))
