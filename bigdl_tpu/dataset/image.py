@@ -303,10 +303,19 @@ class MTLabeledBGRImgToBatch(Transformer):
     with device steps."""
 
     def __init__(self, width: int, height: int, batch_size: int,
-                 transformer: Transformer, depth: int = 8):
+                 transformer: Transformer, depth: int = 8,
+                 data_format: str = "NCHW"):
         from bigdl_tpu.dataset.transformer import Prefetcher
-        self._chain = transformer >> _EnsureSize(width, height) >> \
-            _ImgToSample() >> SampleToBatch(batch_size) >> Prefetcher(depth)
+        chain = transformer >> _EnsureSize(width, height) >> \
+            _ImgToSample() >> SampleToBatch(batch_size)
+        if data_format == "NHWC":
+            # layout change INSIDE the prefetched chain: the background
+            # worker absorbs the transpose instead of serializing it with
+            # device dispatch on the consumer thread
+            chain = chain >> BatchToNHWC()
+        elif data_format != "NCHW":
+            raise ValueError(f"unsupported data_format {data_format!r}")
+        self._chain = chain >> Prefetcher(depth)
 
     def __call__(self, it: Iterator) -> Iterator[MiniBatch]:
         return self._chain(it)

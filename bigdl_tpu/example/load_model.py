@@ -80,11 +80,9 @@ def _dataset(args):
         return DataSet.array(records) >> (
             image.BGRImgNormalizer(cifar.TRAIN_MEAN, cifar.TRAIN_STD)
             >> image.BGRImgToBatch(args.batchSize))
-    import glob
-    import os
-    shards = sorted(glob.glob(os.path.join(args.folder, "*")))
-    val = [s for s in shards if "val" in os.path.basename(s)] or shards
-    return DataSet.record_files(val) >> imagenet_val_pipe(args.batchSize)
+    from bigdl_tpu.models.utils import imagenet_shards
+    return DataSet.record_files(imagenet_shards(args.folder)[1]) \
+        >> imagenet_val_pipe(args.batchSize)
 
 
 def main(argv=None) -> None:

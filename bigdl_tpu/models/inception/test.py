@@ -18,7 +18,7 @@ def main(argv=None) -> None:
 
     from bigdl_tpu import Engine, nn
     from bigdl_tpu.models.utils import imagenet_val_pipe
-    from bigdl_tpu.dataset import DataSet, image
+    from bigdl_tpu.dataset import DataSet
     from bigdl_tpu.optim import LocalValidator, Top1Accuracy, Top5Accuracy
 
     Engine.init()
@@ -26,9 +26,8 @@ def main(argv=None) -> None:
         from bigdl_tpu.models.inception.train import _synthetic_records
         ds = DataSet.array(_synthetic_records(128, seed=9))
     else:
-        shards = sorted(glob.glob(os.path.join(args.folder, "*")))
-        val = [s for s in shards if "val" in os.path.basename(s)] or shards
-        ds = DataSet.record_files(val)
+        from bigdl_tpu.models.utils import imagenet_shards
+        ds = DataSet.record_files(imagenet_shards(args.folder)[1])
     ds = ds >> imagenet_val_pipe(args.batchSize)
     model = nn.Module.load(args.model)
     for method, result in LocalValidator(model, ds).test(

@@ -8,9 +8,7 @@ poly decay).
 from __future__ import annotations
 
 import argparse
-import glob
 import logging
-import os
 
 
 def build_parser() -> argparse.ArgumentParser:

@@ -130,32 +130,35 @@ def imagenet_seq_datasets(folder: str, batch_size: int,
     threaded batcher.  One definition so the four call sites (inception
     train/test, resnet train, load_model) cannot drift.  Returns
     (train_ds, val_ds)."""
-    import glob
-    import os
-
     from bigdl_tpu.dataset import DataSet, image
     from bigdl_tpu.dataset.hadoop_seqfile import AnyBytesToBGRImg
 
-    shards = sorted(glob.glob(os.path.join(folder, "*")))
-    train = [s for s in shards if "train" in os.path.basename(s)] or shards
-    val = [s for s in shards if "val" in os.path.basename(s)] or shards[:1]
+    train, val = imagenet_shards(folder)
     train_ds = DataSet.record_files(train, distributed=distributed)
     val_ds = DataSet.record_files(val)
     train_pipe = image.MTLabeledBGRImgToBatch(
         224, 224, batch_size,
         AnyBytesToBGRImg() >> image.BGRImgRdmCropper(224, 224)
         >> image.HFlip(0.5)
-        >> image.BGRImgNormalizer(IMAGENET_BGR_MEAN, IMAGENET_BGR_STD))
-    val_pipe = imagenet_val_pipe(batch_size)
-    train_ds = train_ds >> train_pipe
-    val_ds = val_ds >> val_pipe
-    if data_format == "NHWC":
-        train_ds = train_ds >> image.BatchToNHWC()
-        val_ds = val_ds >> image.BatchToNHWC()
-    return train_ds, val_ds
+        >> image.BGRImgNormalizer(IMAGENET_BGR_MEAN, IMAGENET_BGR_STD),
+        data_format=data_format)
+    val_pipe = imagenet_val_pipe(batch_size, data_format=data_format)
+    return train_ds >> train_pipe, val_ds >> val_pipe
 
 
-def imagenet_val_pipe(batch_size: int):
+def imagenet_shards(folder: str) -> tuple[list, list]:
+    """(train shards, val shards) under a folder, split by filename —
+    the shared discovery rule for every ImageNet CLI."""
+    import glob
+    import os
+
+    shards = sorted(glob.glob(os.path.join(folder, "*")))
+    train = [s for s in shards if "train" in os.path.basename(s)] or shards
+    val = [s for s in shards if "val" in os.path.basename(s)] or shards[:1]
+    return train, val
+
+
+def imagenet_val_pipe(batch_size: int, data_format: str = "NCHW"):
     """Center-crop evaluation pipeline (the half load_model/test CLIs
     need on their own)."""
     from bigdl_tpu.dataset import image
@@ -164,4 +167,5 @@ def imagenet_val_pipe(batch_size: int):
     return image.MTLabeledBGRImgToBatch(
         224, 224, batch_size,
         AnyBytesToBGRImg() >> image.BGRImgCropper(224, 224)
-        >> image.BGRImgNormalizer(IMAGENET_BGR_MEAN, IMAGENET_BGR_STD))
+        >> image.BGRImgNormalizer(IMAGENET_BGR_MEAN, IMAGENET_BGR_STD),
+        data_format=data_format)

@@ -86,6 +86,37 @@ def main(argv=None) -> None:
                (jax.tree_util.tree_map(sds, params),
                 jax.tree_util.tree_map(sds, opt_state), xs, xs))
 
+    # the flagship bench program: ResNet-50 NHWC bf16 train step
+    from bigdl_tpu.models import ResNet
+    from bigdl_tpu.optim import SGD
+
+    rmodel = ResNet(class_num=1000, depth=50, dataset="imagenet",
+                    data_format="NHWC").build(seed=1)
+    rcrit = nn.ClassNLLCriterion()
+    rmethod = SGD(learning_rate=0.1, momentum=0.9, dampening=0.0)
+    rparams, rbuffers = rmodel.params, rmodel.buffers
+    ropt = rmethod.init_state(rparams)
+
+    def resnet_step(params, buffers, opt_state, x, y, rng):
+        def loss_fn(p, b):
+            out, nb = rmodel.apply(cast_f32_leaves(p, jnp.bfloat16), x,
+                                   buffers=b, training=True, rng=rng)
+            return rcrit.loss(out.astype(jnp.float32), y), nb
+        (loss, nb), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, buffers)
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads)
+        new_params, new_opt = rmethod.update(grads, opt_state, params)
+        return new_params, nb, new_opt, loss
+
+    try_export("resnet50_bench_train_step_b256_nhwc_bf16", resnet_step,
+               (jax.tree_util.tree_map(sds, rparams),
+                jax.tree_util.tree_map(sds, rbuffers),
+                jax.tree_util.tree_map(sds, ropt),
+                jax.ShapeDtypeStruct((256, 224, 224, 3), jnp.bfloat16),
+                jax.ShapeDtypeStruct((256,), jnp.float32),
+                jax.ShapeDtypeStruct((2,), jnp.uint32)))
+
     doc = {"note": "jax.export platforms=['tpu'] on a CPU host runs the "
            "full Mosaic/TPU lowering pipeline for the Pallas kernels - "
            "a compile-level proof without the chip (hardware timing in "
