@@ -81,7 +81,8 @@ def _dataset(args):
             image.BGRImgNormalizer(cifar.TRAIN_MEAN, cifar.TRAIN_STD)
             >> image.BGRImgToBatch(args.batchSize))
     from bigdl_tpu.models.utils import imagenet_shards
-    return DataSet.record_files(imagenet_shards(args.folder)[1]) \
+    return DataSet.record_files(
+        imagenet_shards(args.folder, val_fallback="all")[1]) \
         >> imagenet_val_pipe(args.batchSize)
 
 

@@ -2,9 +2,7 @@
 from __future__ import annotations
 
 import argparse
-import glob
 import logging
-import os
 
 
 def main(argv=None) -> None:
@@ -27,7 +25,8 @@ def main(argv=None) -> None:
         ds = DataSet.array(_synthetic_records(128, seed=9))
     else:
         from bigdl_tpu.models.utils import imagenet_shards
-        ds = DataSet.record_files(imagenet_shards(args.folder)[1])
+        ds = DataSet.record_files(
+            imagenet_shards(args.folder, val_fallback="all")[1])
     ds = ds >> imagenet_val_pipe(args.batchSize)
     model = nn.Module.load(args.model)
     for method, result in LocalValidator(model, ds).test(

@@ -146,15 +146,24 @@ def imagenet_seq_datasets(folder: str, batch_size: int,
     return train_ds >> train_pipe, val_ds >> val_pipe
 
 
-def imagenet_shards(folder: str) -> tuple[list, list]:
+def imagenet_shards(folder: str, val_fallback: str = "first"
+                    ) -> tuple[list, list]:
     """(train shards, val shards) under a folder, split by filename —
-    the shared discovery rule for every ImageNet CLI."""
+    the shared discovery rule for every ImageNet CLI.  When no shard name
+    contains "val", the val list falls back per ``val_fallback``:
+    "first" (one shard — cheap in-training validation, the train CLIs'
+    policy) or "all" (the pure-eval CLIs: accuracy over one of 128
+    unlabeled shards would silently mislead)."""
     import glob
     import os
 
+    if val_fallback not in ("first", "all"):
+        raise ValueError(f"val_fallback must be 'first'|'all', got "
+                         f"{val_fallback!r}")
     shards = sorted(glob.glob(os.path.join(folder, "*")))
     train = [s for s in shards if "train" in os.path.basename(s)] or shards
-    val = [s for s in shards if "val" in os.path.basename(s)] or shards[:1]
+    val = [s for s in shards if "val" in os.path.basename(s)] or (
+        shards[:1] if val_fallback == "first" else shards)
     return train, val
 
 
