@@ -69,12 +69,34 @@ def record_layer_inputs(model: Module, x, training: bool = False,
     return records
 
 
-#: v5e planning numbers for the roofline attribution: ~197 TFLOP/s bf16
-#: MXU peak, ~819 GB/s HBM bandwidth.  Only their RATIO matters for
-#: splitting a measured step across layers, so being a generation off
-#: shifts the split, not the total.
-PEAK_FLOPS = 197e12
-PEAK_HBM_BYTES_S = 819e9
+import os as _os
+
+
+def _env_float(name: str, default: float) -> float:
+    """Env override with a loud-but-survivable parse: a malformed value
+    must not break `import bigdl_tpu.parallel` for code that never
+    touches the roofline numbers.  Read at import time — set the vars
+    before importing (they are planning constants, not runtime knobs)."""
+    raw = _os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        import warnings
+        warnings.warn(f"{name}={raw!r} is not a number; using the "
+                      f"default {default}")
+        return default
+
+
+#: planning numbers for the roofline attribution — default v5e (~197
+#: TFLOP/s bf16 MXU peak, ~819 GB/s HBM).  Override for other chip
+#: generations via BIGDL_TPU_PEAK_TFLOPS / BIGDL_TPU_HBM_GBPS (before
+#: first import).  Only their RATIO matters for splitting a measured
+#: step across layers, so being a generation off shifts the split, not
+#: the total.
+PEAK_FLOPS = _env_float("BIGDL_TPU_PEAK_TFLOPS", 197.0) * 1e12
+PEAK_HBM_BYTES_S = _env_float("BIGDL_TPU_HBM_GBPS", 819.0) * 1e9
 
 
 def _cost_of_compiled(compiled) -> tuple[float, float]:
@@ -271,7 +293,7 @@ def _shape_bytes(shape_str: str) -> int:
 #: directions of one axis concurrently, so ~90 GB/s effective per chip is
 #: the planning number (the "How to Scale Your Model" recipe: bytes moved /
 #: ICI bandwidth = collective time; bytes from the compiled program below).
-ICI_GBPS_DEFAULT = 90.0
+ICI_GBPS_DEFAULT = _env_float("BIGDL_TPU_ICI_GBPS", 90.0)
 
 
 def wire_bytes(footprint: dict[str, int], n: int) -> float:
