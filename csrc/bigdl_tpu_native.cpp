@@ -16,6 +16,10 @@
 #include <cmath>
 #include <cstdlib>
 
+#include <pthread.h>
+
+#include <condition_variable>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -469,50 +473,155 @@ int64_t bt_tokenize(const uint8_t* s, int64_t len,
 // image batcher: crop/flip/pack HWC uint8 records into an NHWC batch
 // (the native hot loop behind models/utils/pipeline_bench.batch_stream;
 // the reference threads this work over Engine cores in
-// MTLabeledBGRImgToBatch.scala:52-80 — here it is std::thread + memcpy,
-// flips done per-pixel, everything stays uint8)
+// MTLabeledBGRImgToBatch.scala:52-80).  Work runs on a PERSISTENT
+// worker pool — the batcher is called once per training batch, and
+// paying thread create/join on every call would tax exactly the
+// steady-state path it exists to speed up.
 // ---------------------------------------------------------------------
+
+namespace {
+
+struct BatchJob {
+    const uint8_t** recs;
+    int64_t batch;
+    int32_t stored_h, stored_w, crop;
+    const int32_t* cy;
+    const int32_t* cx;
+    const uint8_t* flip;
+    uint8_t* out;
+};
+
+void pack_range(const BatchJob& j, int64_t lo, int64_t hi) {
+    const int64_t out_img = (int64_t)j.crop * j.crop * 3;
+    for (int64_t b = lo; b < hi; ++b) {
+        const uint8_t* src = j.recs[b];
+        uint8_t* dst = j.out + b * out_img;
+        for (int32_t r = 0; r < j.crop; ++r) {
+            const uint8_t* row =
+                src + ((int64_t)(j.cy[b] + r) * j.stored_w + j.cx[b]) * 3;
+            uint8_t* drow = dst + (int64_t)r * j.crop * 3;
+            if (!j.flip[b]) {
+                std::memcpy(drow, row, (size_t)j.crop * 3);
+            } else {
+                for (int32_t cpx = 0; cpx < j.crop; ++cpx) {
+                    const uint8_t* px = row + (int64_t)(j.crop - 1 - cpx) * 3;
+                    drow[cpx * 3 + 0] = px[0];
+                    drow[cpx * 3 + 1] = px[1];
+                    drow[cpx * 3 + 2] = px[2];
+                }
+            }
+        }
+    }
+}
+
+class PackPool {
+  public:
+    explicit PackPool(int n) : n_(n), done_(0), epoch_(0),
+                               shutdown_(false) {
+        for (int i = 0; i < n_; ++i)
+            workers_.emplace_back([this, i] { loop(i); });
+    }
+
+    ~PackPool() {
+        {
+            std::unique_lock<std::mutex> lk(m_);
+            shutdown_ = true;
+            ++epoch_;
+        }
+        cv_.notify_all();
+        for (auto& w : workers_) w.join();
+    }
+
+    void run(const BatchJob& job) {
+        {
+            std::unique_lock<std::mutex> lk(m_);
+            job_ = job;
+            done_ = 0;
+            ++epoch_;
+        }
+        cv_.notify_all();
+        std::unique_lock<std::mutex> lk(m_);
+        cv_done_.wait(lk, [this] { return done_ == n_; });
+    }
+
+    int size() const { return n_; }
+
+  private:
+    void loop(int idx) {
+        uint64_t seen = 0;
+        for (;;) {
+            BatchJob job;
+            {
+                std::unique_lock<std::mutex> lk(m_);
+                cv_.wait(lk, [&] { return epoch_ != seen; });
+                seen = epoch_;
+                if (shutdown_) return;
+                job = job_;
+            }
+            int64_t per = (job.batch + n_ - 1) / n_;
+            int64_t lo = (int64_t)idx * per;
+            int64_t hi = lo + per < job.batch ? lo + per : job.batch;
+            if (lo < hi) pack_range(job, lo, hi);
+            {
+                std::unique_lock<std::mutex> lk(m_);
+                if (++done_ == n_) cv_done_.notify_one();
+            }
+        }
+    }
+
+    int n_;
+    std::vector<std::thread> workers_;
+    std::mutex m_;
+    std::condition_variable cv_, cv_done_;
+    BatchJob job_;
+    int done_;
+    uint64_t epoch_;
+    bool shutdown_;
+};
+
+std::mutex g_pool_mutex;
+PackPool* g_pool = nullptr;  // leaked intentionally: workers must not be
+                             // joined from atexit while a caller blocks
+
+// fork safety: worker threads do not survive fork(); a child inheriting
+// a non-null pool would publish a job no one answers and hang forever.
+// prepare/parent bracket the fork with the pool lock; the child drops
+// the (threadless) pool and re-creates the mutex in a known state.
+void pool_atfork_prepare() { g_pool_mutex.lock(); }
+void pool_atfork_parent() { g_pool_mutex.unlock(); }
+void pool_atfork_child() {
+    g_pool = nullptr;  // leak: its threads don't exist in this process
+    new (&g_pool_mutex) std::mutex();
+}
+
+struct PoolForkGuard {
+    PoolForkGuard() {
+        pthread_atfork(pool_atfork_prepare, pool_atfork_parent,
+                       pool_atfork_child);
+    }
+} g_pool_fork_guard;
+
+}  // namespace
+
 void bt_crop_flip_pack(const uint8_t** recs, int64_t batch,
                        int32_t stored_h, int32_t stored_w, int32_t crop,
                        const int32_t* cy, const int32_t* cx,
                        const uint8_t* flip, uint8_t* out,
                        int32_t n_threads) {
-    if (n_threads < 1) n_threads = 1;
-    const int64_t out_img = (int64_t)crop * crop * 3;
-    auto work = [&](int64_t lo, int64_t hi) {
-        for (int64_t b = lo; b < hi; ++b) {
-            const uint8_t* src = recs[b];
-            uint8_t* dst = out + b * out_img;
-            for (int32_t r = 0; r < crop; ++r) {
-                const uint8_t* row =
-                    src + ((int64_t)(cy[b] + r) * stored_w + cx[b]) * 3;
-                uint8_t* drow = dst + (int64_t)r * crop * 3;
-                if (!flip[b]) {
-                    std::memcpy(drow, row, (size_t)crop * 3);
-                } else {
-                    for (int32_t cpx = 0; cpx < crop; ++cpx) {
-                        const uint8_t* px = row + (int64_t)(crop - 1 - cpx) * 3;
-                        drow[cpx * 3 + 0] = px[0];
-                        drow[cpx * 3 + 1] = px[1];
-                        drow[cpx * 3 + 2] = px[2];
-                    }
-                }
-            }
-        }
-    };
-    if (n_threads == 1 || batch < 2) {
-        work(0, batch);
+    BatchJob job{recs, batch, stored_h, stored_w, crop, cy, cx, flip, out};
+    if (n_threads <= 1 || batch < 2) {
+        pack_range(job, 0, batch);
         return;
     }
-    std::vector<std::thread> threads;
-    int64_t per = (batch + n_threads - 1) / n_threads;
-    for (int32_t t = 0; t < n_threads; ++t) {
-        int64_t lo = (int64_t)t * per;
-        int64_t hi = lo + per < batch ? lo + per : batch;
-        if (lo >= hi) break;
-        threads.emplace_back(work, lo, hi);
+    std::unique_lock<std::mutex> lk(g_pool_mutex);
+    // grow-only: callers with different thread counts share one pool
+    // (alternating sizes must not tear the pool down on every call);
+    // extra workers on a small job cost a wakeup, not a spawn
+    if (g_pool == nullptr || g_pool->size() < n_threads) {
+        delete g_pool;
+        g_pool = new PackPool(n_threads);
     }
-    for (auto& th : threads) th.join();
+    g_pool->run(job);
 }
 
 }  // extern "C"
