@@ -65,3 +65,21 @@ def rng():
 @pytest.fixture
 def nprng():
     return np.random.RandomState(42)
+
+
+def corrupt_variants(good: bytes, n_trials: int, seed: int = 0):
+    """Yield (trial, corrupted_bytes) for reader fuzz tests: truncations,
+    header-region bit flips, and garbage tails — one shared mutation
+    schedule so the t7 and seqfile fuzz tests cannot drift."""
+    rng = np.random.RandomState(seed)
+    for trial in range(n_trials):
+        data = bytearray(good)
+        mode = trial % 3
+        if mode == 0:
+            data = data[: rng.randint(1, len(data))]
+        elif mode == 1:
+            data[rng.randint(0, min(64, len(data)))] ^= 0xFF
+        else:
+            data = data[: rng.randint(8, len(data))] + bytes(
+                rng.randint(0, 256, size=16, dtype=np.uint8))
+        yield trial, bytes(data)

@@ -458,3 +458,29 @@ class TestResnetCli:
         cli.main(["--dataset", "imagenet", "-f", str(tmp_path),
                   "--depth", "18", "--classNumber", "4", "-b", "2",
                   "-e", "1"])
+
+
+class TestSeqFileRobustness:
+    def test_reader_rejects_corrupt_bytes(self, tmp_path):
+        """Corrupted SequenceFiles raise ValueError-class errors, never
+        hang or crash (same contract as the t7 reader).  Mutated buffers
+        parse in memory via read_sequence_file(data=...)."""
+        import zlib
+
+        from bigdl_tpu.dataset.hadoop_seqfile import (read_sequence_file,
+                                                      write_sequence_file)
+        from tests.conftest import corrupt_variants
+
+        p = str(tmp_path / "good.seq")
+        records = [(f"{i}".encode(), bytes([i]) * 50) for i in range(8)]
+        write_sequence_file(p, records, sync_interval=3,
+                            compression="record")
+        good = open(p, "rb").read()
+        detected = 0
+        for trial, data in corrupt_variants(good, 30, seed=1):
+            try:
+                list(read_sequence_file("<fuzz>", data=data))
+            except (ValueError, EOFError, IndexError, struct.error,
+                    MemoryError, OSError, zlib.error):
+                detected += 1
+        assert detected >= 8
