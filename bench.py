@@ -128,11 +128,20 @@ def _reap_and_exit(signum, frame):
         _kill_group(proc)
     if not _result_printed[0]:
         # never stamp an error AFTER a success line — the driver reads
-        # the last JSON line, and a completed measurement stays the result
-        _emit_error_line(
-            f"supervisor received signal {signum} (driver window closed) "
-            "mid-attempt", tried=-1, final=True)
-    sys.exit(1)
+        # the last JSON line, and a completed measurement stays the result.
+        # os.write, not print: the handler may interrupt a main-thread
+        # print mid-buffer, and a reentrant BufferedWriter call raises.
+        # The leading newline terminates any half-written line first.
+        line = "\n" + json.dumps({
+            "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+            "value": None, "unit": "images/sec/chip", "vs_baseline": None,
+            "error": f"supervisor received signal {signum} "
+                     "(driver window closed) mid-attempt",
+            "tpu_diagnostic": _tpu_holder_diagnostic(),
+            "attempts": -1, "final": True,
+        }) + "\n"
+        os.write(1, line.encode())
+    os._exit(1)
 
 
 def _emit_error_line(tail: str, tried: int, final: bool) -> None:
@@ -159,7 +168,7 @@ def _emit_error_line(tail: str, tried: int, final: bool) -> None:
 def _supervise() -> int:
     signal.signal(signal.SIGTERM, _reap_and_exit)
     signal.signal(signal.SIGINT, _reap_and_exit)
-    attempts = int(os.environ.get("BIGDL_TPU_BENCH_ATTEMPTS", "4"))
+    attempts = max(1, int(os.environ.get("BIGDL_TPU_BENCH_ATTEMPTS", "4")))
     timeout = float(os.environ.get("BIGDL_TPU_BENCH_TIMEOUT", "600"))
     # attempt 1 is a short PROBE: a wedged backend hangs in init, and the
     # diagnosis must land on stdout while any plausible driver window is
@@ -340,6 +349,7 @@ def _run(batch: int) -> None:
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / baseline, 4),
         "batch": batch,
+        "n_chips": n_chips,
     }
     if step_flops:
         # the jitted step is a single-device program: its flops all run

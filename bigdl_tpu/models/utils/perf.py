@@ -132,6 +132,7 @@ def run_scaling_sweep(model_name: str, per_chip_batch: int, iterations: int,
                       real_devices: bool = False,
                       ici_gbps: float = None,
                       assume_compute_s: float = None,
+                      compute_source: str = None,
                       predict_sizes: list = ()) -> dict:
     """Weak-scaling sweep (ref DistriOptimizerPerf's role; target metric
     BASELINE.md 'allreduce scaling eff').  Fixed per-chip batch; global
@@ -245,7 +246,11 @@ def run_scaling_sweep(model_name: str, per_chip_batch: int, iterations: int,
            "ici_model": {
                "ici_gbps": ici_gbps,
                "compute_s": compute_s,
-               "compute_source": ("assumed (real-chip measurement)"
+               # the caller-supplied label describes assume_compute_s and
+               # must not relabel a sweep-measured term
+               "compute_source": (compute_source
+                                  if compute_source and assume_compute_s
+                                  else "assumed (real-chip measurement)"
                                   if assume_compute_s else
                                   f"measured at mesh={rows[0]['mesh']}"),
                "formula": "eff(N) = compute / (compute + wire_bytes(N)/ICI)",
@@ -288,6 +293,9 @@ def main(argv=None) -> None:
     p.add_argument("--assume-compute-s", type=float, default=None,
                    help="use this measured real-chip step time as the "
                         "compute term instead of the sweep's own base step")
+    p.add_argument("--compute-source", default=None,
+                   help="provenance label for --assume-compute-s, e.g. "
+                        "'measured (real v5e chip, bench.py r4)'")
     p.add_argument("--json", default=None,
                    help="write the result as JSON to this path")
     args = p.parse_args(argv)
@@ -301,19 +309,24 @@ def main(argv=None) -> None:
                                    real_devices=args.real_devices,
                                    ici_gbps=args.ici_gbps,
                                    assume_compute_s=args.assume_compute_s,
+                                   compute_source=args.compute_source,
                                    predict_sizes=predict)
+
+        def _interval(r):
+            lo, hi = r["predicted_efficiency_interval"]
+            return f"predicted eff [{lo*100:.1f}%, {hi*100:.1f}%]"
+
         for r in result["sweep"]:
             print(f"mesh {r['mesh']:>3}: {r['mean_step_s']*1000:8.1f} ms/step, "
                   f"{r['records_s']:9.1f} records/s, "
                   f"measured eff {r['measured_efficiency']*100:6.1f}%, "
-                  f"predicted eff {r['predicted_efficiency']*100:6.1f}% "
+                  f"{_interval(r)} "
                   f"({r['collective_wire_bytes_per_chip']/1e6:.1f} MB wire)")
         for r in result.get("predicted", []):
             if "warning" in r:
                 print(f"mesh {r['mesh']:>3} (predicted): {r['warning']}")
             else:
-                print(f"mesh {r['mesh']:>3} (predicted): eff "
-                      f"{r['predicted_efficiency']*100:6.1f}% "
+                print(f"mesh {r['mesh']:>3} (predicted): {_interval(r)} "
                       f"({r['collective_wire_bytes_per_chip']/1e6:.1f} MB wire)")
     else:
         result = run_perf(args.model, args.batchSize, args.iteration,

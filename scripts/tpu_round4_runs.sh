@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# One-shot TPU measurement battery for the round-4 evidence set.
+# Superset of round 3's: same five stages, then regenerates the scaling
+# predictions with the MEASURED single-chip step time (compute_source:
+# measured) and efficiency intervals.  Run from the repo root when the
+# chip is healthy:
+#
+#     bash scripts/tpu_round4_runs.sh
+set -u
+cd "$(dirname "$0")/.."
+
+bash scripts/tpu_round3_runs.sh
+
+echo "=== scaling: regenerate predictions from the measured bench step" >&2
+timeout 1200 python scripts/regen_scaling_predictions.py BENCH_SMOKE.json
+rc=$?
+if [ $rc -ne 0 ]; then
+  echo "=== scaling regeneration FAILED (rc=$rc)" >&2
+fi
+ls -la SCALING_*_predicted.json >&2
