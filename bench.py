@@ -115,6 +115,7 @@ def _run_attempt(env: dict, budget: float):
 
 
 _result_printed = [False]  # success line already on stdout
+_last_diag = ["not yet scanned (killed before the first attempt failed)"]
 
 
 def _reap_and_exit(signum, frame):
@@ -132,12 +133,15 @@ def _reap_and_exit(signum, frame):
         # os.write, not print: the handler may interrupt a main-thread
         # print mid-buffer, and a reentrant BufferedWriter call raises.
         # The leading newline terminates any half-written line first.
+        # The diagnostic is the CACHED one from the last attempt (the
+        # live scan does /proc walks + TCP probes — seconds we may not
+        # have before the driver's follow-up SIGKILL).
         line = "\n" + json.dumps({
             "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
             "value": None, "unit": "images/sec/chip", "vs_baseline": None,
             "error": f"supervisor received signal {signum} "
                      "(driver window closed) mid-attempt",
-            "tpu_diagnostic": _tpu_holder_diagnostic(),
+            "tpu_diagnostic": _last_diag[0],
             "attempts": -1, "final": True,
         }) + "\n"
         os.write(1, line.encode())
@@ -153,13 +157,15 @@ def _emit_error_line(tail: str, tried: int, final: bool) -> None:
     emitted after EVERY failed attempt — the last line on stdout is
     always the freshest diagnosis, and a success line printed later
     supersedes them all (the driver parses the last JSON line)."""
+    diag = _tpu_holder_diagnostic()
+    _last_diag[0] = diag  # signal-path reuse: the reaper can't afford a scan
     print(json.dumps({
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
         "value": None,
         "unit": "images/sec/chip",
         "vs_baseline": None,
         "error": tail[-600:],
-        "tpu_diagnostic": _tpu_holder_diagnostic(),
+        "tpu_diagnostic": diag,
         "attempts": tried,
         "final": final,
     }), flush=True)
@@ -274,6 +280,17 @@ def main() -> None:
 
 def _run(batch: int) -> None:
     import jax
+
+    try:
+        # persistent compile cache: a retried attempt (fresh process, same
+        # program) must not pay the 20-40s ResNet-50 compile again inside
+        # its timeout window.  Harmless where unsupported.
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("BIGDL_TPU_COMPILE_CACHE",
+                                         "/tmp/bigdl_tpu_jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
     import jax.numpy as jnp
     import numpy as np
     from bigdl_tpu import nn
@@ -359,7 +376,16 @@ def _run(batch: int) -> None:
         result["tflops_per_chip"] = round(achieved / 1e12, 2)
         result["mfu"] = round(achieved / PEAK_FLOPS, 4)
         result["mfu_peak_tflops_assumed"] = round(PEAK_FLOPS / 1e12, 1)
-    print(json.dumps(result))
+    line = json.dumps(result)
+    print(line)
+    try:
+        # also leave the result next to the script: if the driver's
+        # stdout handling fails, the measurement still lands in the repo
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BENCH_LAST.json"), "w") as f:
+            f.write(line + "\n")
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":

@@ -303,7 +303,47 @@ class Engine:
                     notes.append(f"pid {pid} holds libtpu: {cmd[:120]}")
         except OSError:
             pass
+        notes.extend(Engine._diagnose_tunnel())
         return "; ".join(notes) if notes else "no stale TPU holder found"
+
+    @staticmethod
+    def _diagnose_tunnel() -> list:
+        """Probe the tunneled-backend control plane.  When the backend
+        proxies to a remote pool (PALLAS_AXON_POOL_IPS / a
+        *_POOL_SVC_OVERRIDE host), client init dials the pool service and
+        terminal ports on that host and, if nothing listens, retries with
+        backoff forever — from the outside indistinguishable from a slow
+        compile.  A 1s TCP probe per port names the difference: refused
+        means the relay/terminal process is gone (infra, not us); a
+        listener that accepts means the hang is past connect (claim or
+        compile)."""
+        host = None
+        for var in ("AXON_POOL_SVC_OVERRIDE", "PALLAS_AXON_POOL_IPS"):
+            v = os.environ.get(var)
+            if v:
+                host = v.split(",")[0].strip()
+                break
+        if not host:
+            return []
+        import socket
+        targets = [(8080, "pool-svc"), (8083, "terminal")]
+        if ":" in host and not host.startswith("["):  # host:port form
+            host, _, explicit = host.rpartition(":")
+            try:
+                targets = [(int(explicit), "pool-svc")]
+            except ValueError:
+                return []  # unparseable — better silent than misleading
+        notes = []
+        for port, what in targets:
+            try:
+                with socket.create_connection((host, port), timeout=1.0):
+                    notes.append(f"{what} {host}:{port} accepts connections")
+            except OSError as e:
+                notes.append(
+                    f"{what} {host}:{port} unreachable ({e.strerror or e}) "
+                    "- backend init will retry forever; the tunnel relay "
+                    "appears to be down")
+        return notes
 
     @staticmethod
     def reset() -> None:
