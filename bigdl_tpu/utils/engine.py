@@ -327,12 +327,20 @@ class Engine:
             return []
         import socket
         targets = [(8080, "pool-svc"), (8083, "terminal")]
-        if ":" in host and not host.startswith("["):  # host:port form
-            host, _, explicit = host.rpartition(":")
+        if host.startswith("["):  # bracketed IPv6, maybe [::1]:8080
+            inner, _, rest = host[1:].partition("]")
+            host = inner
+            if rest.startswith(":"):
+                try:
+                    targets = [(int(rest[1:]), "pool-svc")]
+                except ValueError:
+                    return []  # unparseable — better silent than misleading
+        elif host.count(":") == 1:  # host:port form (bare IPv6 has >1)
+            host, _, explicit = host.partition(":")
             try:
                 targets = [(int(explicit), "pool-svc")]
             except ValueError:
-                return []  # unparseable — better silent than misleading
+                return []
         notes = []
         for port, what in targets:
             try:
