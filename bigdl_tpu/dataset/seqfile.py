@@ -38,6 +38,32 @@ def write_shard(path: str, records: Iterable[ByteRecord]) -> int:
     return n
 
 
+# Epoch-persistent index cache: shard files are immutable during a
+# training run, but a multi-epoch loop re-reads every shard each epoch
+# — and re-validating every payload CRC dominated the host pipeline
+# (~63% of delivery time measured on the bench host, seqfile indexing
+# at ~290ms per 100MB shard).  First read of a file validates fully
+# (corruption is caught where it enters); re-reads reuse the index when
+# the signature matches.  The signature is (mtime_ns, size) PLUS crc32
+# of three 4KB windows (head/middle/tail) of the actual bytes, so
+# same-size rewrites on coarse-mtime filesystems and edge bit rot are
+# caught; a middle-of-file flip inside an unchanged window is the
+# residual blind spot between first read and rewrite.  Archival-grade
+# readers can set BIGDL_TPU_SHARD_INDEX_CACHE=0 to re-validate every
+# payload CRC on every read (the pre-cache behavior).
+_INDEX_CACHE: dict = {}
+_INDEX_CACHE_MAX = 4096  # ~1000 ImageNet shards; a few MB of arrays
+
+
+def _shard_signature(path: str, buf: bytes) -> tuple:
+    st = os.stat(path)
+    k = 4096
+    mid = max(0, len(buf) // 2 - k // 2)
+    return (st.st_mtime_ns, st.st_size,
+            zlib.crc32(buf[:k]), zlib.crc32(buf[mid:mid + k]),
+            zlib.crc32(buf[-k:]))
+
+
 def read_shard(path: str) -> Iterator[ByteRecord]:
     try:  # native one-pass indexer (csrc/bigdl_tpu_native.cpp bt_shard_index)
         from bigdl_tpu import native
@@ -47,10 +73,21 @@ def read_shard(path: str) -> Iterator[ByteRecord]:
     if lib is not None:
         with open(path, "rb") as f:
             buf = f.read()
-        try:
-            offsets, lengths, labels = lib.shard_index(buf)
-        except ValueError as e:
-            raise ValueError(f"{path}: {e}") from None
+        use_cache = os.environ.get(
+            "BIGDL_TPU_SHARD_INDEX_CACHE", "1") not in ("0", "false")
+        sig = _shard_signature(path, buf) if use_cache else None
+        cached = _INDEX_CACHE.get(path) if use_cache else None
+        if cached is not None and cached[0] == sig:
+            offsets, lengths, labels = cached[1]
+        else:
+            try:
+                offsets, lengths, labels = lib.shard_index(buf)
+            except ValueError as e:
+                raise ValueError(f"{path}: {e}") from None
+            if use_cache:
+                if len(_INDEX_CACHE) >= _INDEX_CACHE_MAX:
+                    _INDEX_CACHE.clear()  # crude but bounded; refills fast
+                _INDEX_CACHE[path] = (sig, (offsets, lengths, labels))
         for off, length, label in zip(offsets, lengths, labels):
             yield ByteRecord(buf[off:off + length], float(label))
         return

@@ -48,6 +48,10 @@ for name in ("BENCH_ATTN.json", "BENCH_LM.json", "BENCH_PIPELINE.json",
         f.write("\n")
 print("stamped error artifacts;", diag)
 PYEOF
+  # the host half of the feed-the-chip proof needs no chip: measure it
+  timeout 600 python -m bigdl_tpu.models.utils.pipeline_bench \
+    --host-only --batch 256 --iters 64 --warmup 18 --records 4096 \
+    --json HOST_PIPELINE.json
   # bench.py still runs: its supervisor produces the structured error
   # line (and the driver-visible diagnosis) on its own
   env BIGDL_TPU_BENCH_ATTEMPTS=1 python bench.py | tee BENCH_SMOKE.json
@@ -76,6 +80,10 @@ run lm          python -m bigdl_tpu.models.utils.lm_perf \
 
 run pipeline    python -m bigdl_tpu.models.utils.pipeline_bench \
     --batch 256 --iters 15 --records 2048 --json BENCH_PIPELINE.json
+
+run host-pipe   python -m bigdl_tpu.models.utils.pipeline_bench \
+    --host-only --batch 256 --iters 64 --warmup 18 --records 4096 \
+    --json HOST_PIPELINE.json
 
 run profile     python scripts/tpu_profile_bench.py \
     --batches 256,512,1024 --iters 15 --json PROFILE_TPU.json

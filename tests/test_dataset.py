@@ -88,6 +88,38 @@ class TestSeqFile:
         with pytest.raises(ValueError):
             list(read_shard(str(p)))
 
+    def test_index_cache_reuse_and_invalidation(self, tmp_path):
+        """Epoch re-reads hit the index cache (no re-validation), but a
+        rewritten file must be re-indexed — stale indexes silently
+        serving wrong slices would corrupt training data."""
+        recs = [ByteRecord(bytes([i] * 10), float(i)) for i in range(5)]
+        p = str(tmp_path / "shard-c")
+        write_shard(p, recs)
+        first = list(read_shard(p))
+        second = list(read_shard(p))  # cache hit (same mtime_ns/size)
+        assert [r.data for r in first] == [r.data for r in second]
+        # rewrite with different content AND size: must re-index
+        recs2 = [ByteRecord(bytes([9 - i] * 24), float(i)) for i in range(7)]
+        write_shard(p, recs2)
+        third = list(read_shard(p))
+        assert len(third) == 7 and third[0].data == bytes([9] * 24)
+        # SAME-SIZE rewrite (coarse-mtime filesystems can't tell):
+        # the content windows in the signature must catch it
+        recs3 = [ByteRecord(bytes([i + 40] * 24), float(i + 1))
+                 for i in range(7)]
+        write_shard(p, recs3)
+        fourth = list(read_shard(p))
+        assert fourth[0].data == bytes([40] * 24)
+        assert fourth[0].label == 1.0
+        # corrupt the payload of an already-cached path: signature
+        # changes => revalidation => ValueError, not silent bad data
+        raw = bytearray(open(p, "rb").read())
+        raw[-1] ^= 0xFF
+        with open(p, "wb") as f:
+            f.write(raw + b"\x00")  # size change forces signature miss
+        with pytest.raises(ValueError):
+            list(read_shard(p))
+
 
 class TestImageTransformers:
     def test_bytes_to_grey(self):
