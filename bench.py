@@ -281,6 +281,12 @@ def main() -> None:
 def _run(batch: int) -> None:
     import jax
 
+    plat = os.environ.get("BIGDL_TPU_BENCH_PLATFORM")
+    if plat:
+        # test/CI hook: the sitecustomize pins the platform at interpreter
+        # start, so a plain JAX_PLATFORMS env var is ignored — this config
+        # update (before first backend use) is the supported escape hatch
+        jax.config.update("jax_platforms", plat)
     try:
         # persistent compile cache: a retried attempt (fresh process, same
         # program) must not pay the 20-40s ResNet-50 compile again inside

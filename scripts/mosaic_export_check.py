@@ -302,7 +302,15 @@ def main(argv=None) -> None:
     for names, thunk in programs.items():
         if args.only and args.only not in names:
             continue
-        thunk()
+        try:
+            thunk()
+        except Exception as e:
+            # setup plumbing (model build, sharding rules) must not sink
+            # the battery: record one failed entry, keep exporting
+            key = names.split()[0] + "_setup"
+            results[key] = {"ok": False,
+                            "error": f"{type(e).__name__}: {str(e)[:300]}"}
+            print(key, results[key], flush=True)
 
     doc = {"note": "jax.export platforms=['tpu'] on a CPU host runs the "
            "full Mosaic/TPU lowering pipeline for the Pallas kernels - "

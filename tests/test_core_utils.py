@@ -112,3 +112,47 @@ def test_engine_diagnose_tpu_smoke():
     from bigdl_tpu.utils.engine import Engine
     report = Engine.diagnose_tpu()
     assert isinstance(report, str) and report
+
+
+def test_diagnose_tunnel_listener_vs_refused(monkeypatch):
+    """The tunnel probe must say 'accepts connections' for a live
+    listener and 'unreachable' for a dead port — the string that decides
+    whether an outage gets triaged as infra (relay down) or as a hang
+    past connect.  host, host:port, and bracketed-IPv6 forms parse."""
+    import socket
+
+    from bigdl_tpu.utils.engine import Engine
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    try:
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", f"127.0.0.1:{port}")
+        notes = Engine._diagnose_tunnel()
+        assert len(notes) == 1 and "accepts connections" in notes[0]
+
+        # refused: grab a port and close it so nothing listens there
+        tmp = socket.socket()
+        tmp.bind(("127.0.0.1", 0))
+        dead = tmp.getsockname()[1]
+        tmp.close()
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", f"127.0.0.1:{dead}")
+        notes = Engine._diagnose_tunnel()
+        assert len(notes) == 1 and "unreachable" in notes[0]
+        assert "retry forever" in notes[0]
+
+        # bare host probes both default ports
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "127.0.0.1")
+        assert len(Engine._diagnose_tunnel()) == 2
+
+        # unparseable port: silent beats misleading
+        monkeypatch.setenv("AXON_POOL_SVC_OVERRIDE", "127.0.0.1:notaport")
+        assert Engine._diagnose_tunnel() == []
+
+        # no env at all: no probes
+        monkeypatch.delenv("AXON_POOL_SVC_OVERRIDE", raising=False)
+        monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+        assert Engine._diagnose_tunnel() == []
+    finally:
+        srv.close()

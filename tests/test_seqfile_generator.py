@@ -71,3 +71,22 @@ def test_pipeline_bench_stream_shapes(tmp_path):
         assert x.shape == (8, 16, 16, 3)
     finally:
         pb.CROP, pb.STORED = crop, stored
+
+
+def test_pipeline_bench_host_only_mode(tmp_path):
+    """--host-only measures delivery with no device step (it must work
+    with a wedged accelerator: no jax backend use anywhere on the path)
+    and reports the headroom against the recorded chip rate."""
+    import bigdl_tpu.models.utils.pipeline_bench as pb
+    crop, stored = pb.CROP, pb.STORED
+    pb.CROP, pb.STORED = 16, 24
+    try:
+        r = pb.run_host_only(batch=8, iters=6, warmup=2,
+                             workdir=str(tmp_path), n_records=32)
+    finally:
+        pb.CROP, pb.STORED = crop, stored
+    assert r["value"] > 0
+    assert r["metric"] == "input_pipeline_host_delivery_images_per_sec"
+    assert 0 < r["headroom_vs_r1_chip_rate"] == round(
+        r["value"] / r["chip_consumption_rate_r1"], 3)
+    assert isinstance(r["native_batcher"], bool)
