@@ -1,0 +1,100 @@
+#!/usr/bin/env bash
+# Opportunistic measurement battery for a backend that comes and goes.
+#
+# Round-4 observation: the tunneled TPU backend has *windows* of
+# availability (e.g. 03:46:55-03:48:16 on 2026-07-31) separated by long
+# dead spells where backend init blocks in tcp_recvmsg against the
+# terminal port forever.  A linear battery (tpu_round3_runs.sh) burns
+# its stage timeouts against a dead backend; this runner instead polls
+# cheaply and, the moment the chip answers, drains as many incomplete
+# stages as the window allows — highest-value first.  The persistent
+# JAX compile cache carries compile progress across windows.
+#
+#     bash scripts/chip_opportunist.sh [logfile]
+#
+# Exits 0 when every stage's artifact is valid.
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-opportunist.log}"
+
+say() { echo "$(date +%H:%M:%S) $*" >> "$LOG"; }
+
+# A stage artifact counts as done when it parses as JSON and carries
+# real data (no top-level "error"; the headline bench must additionally
+# clear a sanity floor so a degraded-window crawl — e.g. one step
+# completing at 0.12 img/s before the backend died — can never
+# permanently mark the stage DONE and poison the scaling regeneration).
+ok() {  # ok <file>
+  python - "$1" <<'PYEOF'
+import json, sys
+try:
+    d = json.load(open(sys.argv[1]))
+except Exception:
+    sys.exit(1)
+if isinstance(d, dict) and d.get("error"):
+    sys.exit(1)
+if isinstance(d, dict) and "value" in d:
+    if not d.get("value") or d["value"] < 100:
+        sys.exit(1)
+sys.exit(0)
+PYEOF
+}
+
+alive() {
+  timeout 30 python -u -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+run_stage() {  # run_stage <name> <artifact> <budget> <cmd...>
+  local name="$1" art="$2" budget="$3"; shift 3
+  ok "$art" && return 0
+  say "stage $name: firing (budget ${budget}s): $*"
+  timeout "$budget" "$@" >> "$LOG" 2>&1
+  local rc=$?
+  if ok "$art"; then
+    say "stage $name: DONE"
+    return 0
+  fi
+  say "stage $name: not done (rc=$rc)"
+  return 1
+}
+
+say "opportunist start"
+while :; do
+  all_done=1
+  for probe_art in BENCH_LAST.json BENCH_ATTN.json BENCH_LM.json \
+                   BENCH_PIPELINE.json PROFILE_TPU.json; do
+    ok "$probe_art" || { all_done=0; break; }
+  done
+  if [ $all_done -eq 1 ]; then
+    say "all artifacts valid - regenerating scaling predictions"
+    cp BENCH_LAST.json BENCH_SMOKE.json
+    timeout 600 python scripts/regen_scaling_predictions.py BENCH_SMOKE.json \
+      >> "$LOG" 2>&1 || say "scaling regen failed"
+    say "opportunist COMPLETE"
+    exit 0
+  fi
+  if alive; then
+    say "chip ALIVE - draining stages"
+    # Highest value first; each stage re-checks its own artifact so a
+    # completed one is skipped instantly on later passes.
+    BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=20 \
+      run_stage bench BENCH_LAST.json 420 python -u bench.py
+    run_stage attention BENCH_ATTN.json 900 \
+      python -u -m bigdl_tpu.models.utils.attention_bench \
+        --sweep 2048,8192,16384,32768 --naive --iters 5 --json BENCH_ATTN.json
+    run_stage lm BENCH_LM.json 900 \
+      python -u -m bigdl_tpu.models.utils.lm_perf \
+        --sweep 2048,8192,16384 -b 8 -t 2048 --flash --remat -i 5 \
+        --json BENCH_LM.json
+    run_stage pipeline BENCH_PIPELINE.json 600 \
+      python -u -m bigdl_tpu.models.utils.pipeline_bench \
+        --batch 256 --iters 15 --records 2048 --json BENCH_PIPELINE.json
+    run_stage profile PROFILE_TPU.json 1200 \
+      python -u scripts/tpu_profile_bench.py \
+        --batches 256,512,1024 --iters 15 --flag-sweep --deadline 1100 \
+        --timeout 500 --json PROFILE_TPU.json
+  else
+    say "probe: dead"
+    sleep 20
+  fi
+done

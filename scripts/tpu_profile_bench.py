@@ -66,11 +66,21 @@ def _measure_one(batch: int, timeout: float, iters: int,
     return row
 
 
-def measure_tpu(batches, timeout: float, iters: int) -> list[dict]:
+def measure_tpu(batches, timeout: float, iters: int, deadline: float,
+                flush=None) -> list[dict]:
     rows = []
     for b in batches:
-        row = _measure_one(b, timeout, iters)
+        remaining = deadline - time.time()
+        if remaining < 60:
+            # no silent caps: record what the deadline dropped
+            rows.append({"batch": b, "error": "skipped: deadline exhausted"})
+            if flush:
+                flush()
+            continue
+        row = _measure_one(b, min(timeout, remaining), iters)
         rows.append(row)
+        if flush:
+            flush()
         print(json.dumps(row), flush=True)
     return rows
 
@@ -89,13 +99,24 @@ FLAG_PRESETS = {
 }
 
 
-def sweep_flags(batch: int, timeout: float, iters: int) -> list[dict]:
+def sweep_flags(batch: int, timeout: float, iters: int, deadline: float,
+                flush=None) -> list[dict]:
     rows = []
     for name, flags in FLAG_PRESETS.items():
-        row = _measure_one(batch, timeout, iters, xla_flags=flags)
+        remaining = deadline - time.time()
+        if remaining < 60:
+            rows.append({"preset": name, "xla_flags": flags,
+                         "error": "skipped: deadline exhausted"})
+            if flush:
+                flush()
+            continue
+        row = _measure_one(batch, min(timeout, remaining), iters,
+                           xla_flags=flags)
         row["preset"] = name
         row["xla_flags"] = flags
         rows.append(row)
+        if flush:
+            flush()
         print(json.dumps(row), flush=True)
     return rows
 
@@ -142,18 +163,32 @@ def main(argv=None) -> None:
                    help="after the batch sweep, re-measure the best batch "
                         "under each XLA flag preset (MFU experiment loop "
                         "in one invocation)")
+    p.add_argument("--deadline", type=float, default=2200.0,
+                   help="total wall-clock budget (s); rows that would "
+                        "overrun are recorded as skipped, and the artifact "
+                        "is rewritten after every row so an outer kill "
+                        "keeps everything measured so far")
     p.add_argument("--json", default="PROFILE_TPU.json")
     args = p.parse_args(argv)
 
+    deadline = time.time() + args.deadline
     batches = [int(b) for b in args.batches.split(",")]
     result = {"metric": "resnet50_tpu_profile"}
+
+    def flush():
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+            f.write("\n")
+
     if not args.skip_measure:
-        result["measurements"] = measure_tpu(batches, args.timeout, args.iters)
-        good = [r for r in result["measurements"] if "step_s" in r and r["step_s"]]
+        result["measurements"] = rows = []
+        rows.extend(measure_tpu(batches, args.timeout, args.iters,
+                                deadline, flush))
+        good = [r for r in rows if "step_s" in r and r["step_s"]]
         best = max(good, key=lambda r: r["images_per_s"]) if good else None
         if args.flag_sweep and best:
             result["flag_sweep"] = sweep_flags(best["batch"], args.timeout,
-                                               args.iters)
+                                               args.iters, deadline, flush)
             flagged = [r for r in result["flag_sweep"]
                        if r.get("images_per_s")]
             if flagged:
@@ -161,13 +196,16 @@ def main(argv=None) -> None:
                 # compare against the sweep's own fresh baseline row —
                 # the pre-sweep batch measurement ran under different
                 # cache/load conditions and would book run-to-run noise
-                # as flag gain
+                # as flag gain; when that row is missing the degraded
+                # denominator is recorded, not hidden
                 base = next((r for r in flagged
                              if r["preset"] == "baseline"), None)
                 denom = (base or best)["images_per_s"]
                 result["best_preset"] = {
                     "preset": top["preset"], "xla_flags": top["xla_flags"],
                     "images_per_s": top["images_per_s"],
+                    "baseline_source": ("flag_sweep_baseline" if base
+                                        else "pre_sweep_batch_row"),
                     "gain_vs_baseline": round(
                         top["images_per_s"] / denom, 4)}
     else:
@@ -182,9 +220,7 @@ def main(argv=None) -> None:
             "layers": attribute_cpu(step_s, batch)}
     else:
         result["error"] = "no successful TPU measurement to attribute"
-    with open(args.json, "w") as f:
-        json.dump(result, f, indent=2)
-        f.write("\n")
+    flush()
     print(json.dumps({"written": args.json,
                       "best": best, "attributed": bool(step_s)}))
 

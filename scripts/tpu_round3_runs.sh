@@ -86,7 +86,8 @@ run host-pipe   python -m bigdl_tpu.models.utils.pipeline_bench \
     --json HOST_PIPELINE.json
 
 run profile     python scripts/tpu_profile_bench.py \
-    --batches 256,512,1024 --iters 15 --flag-sweep --json PROFILE_TPU.json
+    --batches 256,512,1024 --iters 15 --flag-sweep --deadline 2300 \
+    --json PROFILE_TPU.json
 
 echo "=== battery complete; artifacts:" >&2
 ls -la BENCH_*.json PROFILE_TPU.json 2>/dev/null >&2
