@@ -42,6 +42,10 @@ import time
 # Supervisor: retry/backoff around a subprocess per attempt.
 # ---------------------------------------------------------------------------
 
+#: Batch fallback ladder for the default recipe (OOM steps down); also
+#: the set of batches a default-run replay may legitimately come from.
+_DEFAULT_BATCHES = (512, 256, 128)
+
 _RETRYABLE_MARKERS = (
     "UNAVAILABLE",
     "JaxRuntimeError",
@@ -77,6 +81,7 @@ def _kill_group(proc: "subprocess.Popen") -> None:
 
 _child: list = [None]  # current in-flight attempt, for the SIGTERM reaper
 _cached_result: list = [None]  # replay-worthy BENCH_LAST, for the reaper
+_last_tail: list = [None]  # last failed attempt's tail (None = none yet)
 
 
 def _run_attempt(env: dict, budget: float):
@@ -163,10 +168,20 @@ def _load_cached_result():
     if time.time() - d["measured_at_unix"] > 12 * 3600:
         return None
     want_batch = os.environ.get("BIGDL_TPU_BENCH_BATCH")
-    if want_batch and str(d.get("batch")) != want_batch:
+    if want_batch:
+        if str(d.get("batch")) != want_batch:
+            return None
+    elif d.get("batch") not in _DEFAULT_BATCHES:
+        # default run must not be answered with an experiment's batch
         return None
-    if (os.environ.get("BIGDL_TPU_BENCH_XLA_FLAGS", "")
-            != d.get("xla_flags", "")):
+    # compare the flags the inner process would actually see (the
+    # supervisor merges BIGDL_TPU_BENCH_XLA_FLAGS into XLA_FLAGS; other
+    # tools inject XLA_FLAGS directly) against what the cached run saw
+    eff = os.environ.get("XLA_FLAGS", "")
+    extra = os.environ.get("BIGDL_TPU_BENCH_XLA_FLAGS")
+    if extra:
+        eff = (eff + " " + extra).strip()
+    if d.get("xla_flags_effective", "") != eff:
         return None
     return d
 
@@ -235,8 +250,13 @@ def _reap_and_exit(signum, frame):
         os.write(1, line.encode())
         # preloaded at supervisor start — a file read here could outlive
         # the driver's follow-up SIGKILL; json.dumps on a dict is safe
-        # in a handler (no reentrant buffered IO)
-        if _cached_result[0] is not None:
+        # in a handler (no reentrant buffered IO).  Same gate as the
+        # normal path: replay covers outage-shaped failures only — a
+        # kill before any attempt finished counts (the in-flight attempt
+        # was hanging on the backend), a bug-shaped last failure doesn't.
+        tail = _last_tail[0]
+        outage = tail is None or any(m in tail for m in _OUTAGE_MARKERS)
+        if _cached_result[0] is not None and outage:
             os.write(1, (_replay_line(_cached_result[0]) + "\n").encode())
             os._exit(0)
     os._exit(1)
@@ -315,6 +335,7 @@ def _supervise() -> int:
                     return 0
             err = err + "\nno JSON result line in output"
         last_tail = (err or out)[-2000:]
+        _last_tail[0] = last_tail  # the reaper's replay gate reads this
         # rc==0 reaching here means "exited clean but printed no result
         # line" — transient truncation is possible, so retry it too
         retryable = (rc == 0 or (
@@ -359,7 +380,7 @@ def main() -> None:
             raise RuntimeError("UNAVAILABLE: simulated backend failure")
         raise RuntimeError(f"simulated deterministic failure ({sim})")
     env_batch = os.environ.get("BIGDL_TPU_BENCH_BATCH")
-    candidates = ([int(env_batch)] if env_batch else [512, 256, 128])
+    candidates = ([int(env_batch)] if env_batch else list(_DEFAULT_BATCHES))
     last_err = None
     for batch in candidates:
         try:
@@ -475,8 +496,11 @@ def _run(batch: int) -> None:
         "measured_at_unix": int(time.time()),
         "platform": jax.devices()[0].platform,
         # replay keys on the requested configuration: a flag-sweep or
-        # batch-override run must never be answered with this number
-        "xla_flags": os.environ.get("BIGDL_TPU_BENCH_XLA_FLAGS", ""),
+        # batch-override run must never be answered with this number.
+        # Record the flags this process ACTUALLY saw — other tools
+        # (tpu_profile_bench) inject presets via XLA_FLAGS directly,
+        # bypassing BIGDL_TPU_BENCH_XLA_FLAGS
+        "xla_flags_effective": os.environ.get("XLA_FLAGS", ""),
     }
     if step_flops:
         # the jitted step is a single-device program: its flops all run
@@ -492,9 +516,12 @@ def _run(batch: int) -> None:
         # also leave the result next to the script: if the driver's
         # stdout handling fails, the measurement still lands in the repo
         # (and becomes the supervisor's replay source if the backend is
-        # dead at the driver's report time)
-        with open(_bench_last_path(), "w") as f:
-            f.write(line + "\n")
+        # dead at the driver's report time).  Experiment invocations
+        # (batch override / injected flag presets) opt out so the replay
+        # source only ever holds recipe-shaped measurements.
+        if not os.environ.get("BIGDL_TPU_BENCH_NO_LAST"):
+            with open(_bench_last_path(), "w") as f:
+                f.write(line + "\n")
     except OSError:
         pass
 

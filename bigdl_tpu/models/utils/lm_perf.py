@@ -106,6 +106,16 @@ def main(argv=None) -> None:
     p.add_argument("--json", default=None,
                    help="write the sweep result document to this path")
     args = p.parse_args(argv)
+
+    import os
+
+    import jax
+
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init()  # honors BIGDL_TPU_PLATFORM (sitecustomize pins the
+    # platform at interpreter start, so a plain JAX_PLATFORMS is ignored)
+
     if not args.sweep:
         print(json.dumps(run_lm_perf(
             args.seqLen, args.batch, vocab=args.vocab, hidden=args.hidden,
@@ -114,29 +124,59 @@ def main(argv=None) -> None:
             iters=args.iteration)))
         return
 
-    import jax
+    # resume: reuse successful same-config rows from a prior killed
+    # sweep so repeated short backend windows make net progress
+    prev = {}
+    if args.json and os.path.exists(args.json):
+        try:
+            with open(args.json) as f:
+                for r in json.load(f).get("rows", []):
+                    if ("tokens_per_s" in r and r.get("vocab") == args.vocab
+                            and r.get("hidden") == args.hidden
+                            and r.get("heads") == args.heads
+                            and r.get("layers") == args.layers
+                            and r.get("remat") == args.remat
+                            and r.get("optim") == args.optim
+                            and r.get("dtype") == args.dtype):
+                        prev[(r.get("seq_len"), r.get("flash"),
+                              r.get("batch"))] = r
+        except (OSError, ValueError):
+            pass
     rows = []
+    result = {"platform": jax.devices()[0].platform, "rows": rows,
+              "complete": False}  # flipped by the final flush
+
+    def flush():
+        # rewrite after every row: a sweep killed mid-flight (flaky
+        # backend window closing) keeps the rows it measured
+        if args.json:
+            from bigdl_tpu.utils import fs
+            fs.atomic_write(args.json,
+                            (json.dumps(result, indent=2) + "\n").encode())
+
     for t in (int(s) for s in args.sweep.split(",")):
         for flash in (True, False):
-            row = {"seq_len": t, "flash": flash}
-            try:
-                # long T at fixed batch would OOM the naive path first;
-                # keep tokens/step constant by shrinking batch
-                eff_batch = max(1, args.batch * args.seqLen // t)
-                row = run_lm_perf(
-                    t, eff_batch, vocab=args.vocab, hidden=args.hidden,
-                    heads=args.heads, layers=args.layers, flash=flash,
-                    remat=args.remat, optim=args.optim, dtype=args.dtype,
-                    iters=args.iteration)
-            except Exception as e:
-                row["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+            # long T at fixed batch would OOM the naive path first;
+            # keep tokens/step constant by shrinking batch
+            eff_batch = max(1, args.batch * args.seqLen // t)
+            if (t, flash, eff_batch) in prev:
+                row = dict(prev[(t, flash, eff_batch)],
+                           reused_from_previous_run=True)
+            else:
+                row = {"seq_len": t, "flash": flash}
+                try:
+                    row = run_lm_perf(
+                        t, eff_batch, vocab=args.vocab, hidden=args.hidden,
+                        heads=args.heads, layers=args.layers, flash=flash,
+                        remat=args.remat, optim=args.optim, dtype=args.dtype,
+                        iters=args.iteration)
+                except Exception as e:
+                    row["error"] = f"{type(e).__name__}: {str(e)[:300]}"
             rows.append(row)
+            flush()
             print(json.dumps(row), flush=True)
-    result = {"platform": jax.devices()[0].platform, "rows": rows}
-    if args.json:
-        from bigdl_tpu.utils import fs
-        fs.atomic_write(args.json,
-                        (json.dumps(result, indent=2) + "\n").encode())
+    result["complete"] = True
+    flush()
 
 
 if __name__ == "__main__":

@@ -33,6 +33,8 @@ except Exception:
     sys.exit(1)
 if isinstance(d, dict) and d.get("error"):
     sys.exit(1)
+if isinstance(d, dict) and d.get("complete") is False:
+    sys.exit(1)  # incremental artifact from a killed sweep: keep firing
 if isinstance(d, dict) and "value" in d:
     if not d.get("value") or d["value"] < 100:
         sys.exit(1)

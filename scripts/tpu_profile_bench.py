@@ -36,6 +36,9 @@ def _measure_one(batch: int, timeout: float, iters: int,
     env["BIGDL_TPU_BENCH_INNER"] = "1"
     env["BIGDL_TPU_BENCH_BATCH"] = str(batch)
     env["BIGDL_TPU_BENCH_ITERS"] = str(iters)
+    # profiler rows are experiments, not the recipe measurement — they
+    # must never become bench.py's replay source
+    env["BIGDL_TPU_BENCH_NO_LAST"] = "1"
     if xla_flags:
         env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " "
                             + xla_flags).strip()
@@ -67,8 +70,12 @@ def _measure_one(batch: int, timeout: float, iters: int,
 
 
 def measure_tpu(batches, timeout: float, iters: int, deadline: float,
-                flush=None) -> list[dict]:
-    rows = []
+                flush=None, out=None) -> list[dict]:
+    # append into the caller's live list (out): flush() serializes the
+    # whole result document, so rows must land there AS they complete,
+    # not via an extend after the loop — an outer kill mid-sweep must
+    # find every finished row already in the artifact
+    rows = out if out is not None else []
     for b in batches:
         remaining = deadline - time.time()
         if remaining < 60:
@@ -100,9 +107,11 @@ FLAG_PRESETS = {
 
 
 def sweep_flags(batch: int, timeout: float, iters: int, deadline: float,
-                flush=None) -> list[dict]:
-    rows = []
+                flush=None, skip=(), out=None) -> list[dict]:
+    rows = out if out is not None else []  # see measure_tpu on `out`
     for name, flags in FLAG_PRESETS.items():
+        if name in skip:  # already measured by a prior run (resume)
+            continue
         remaining = deadline - time.time()
         if remaining < 60:
             rows.append({"preset": name, "xla_flags": flags,
@@ -173,7 +182,24 @@ def main(argv=None) -> None:
 
     deadline = time.time() + args.deadline
     batches = [int(b) for b in args.batches.split(",")]
-    result = {"metric": "resnet50_tpu_profile"}
+    # resume: reuse successful rows from a prior killed run so repeated
+    # short backend windows make net progress (keyed by batch for the
+    # sweep, by preset+batch for the flag experiments)
+    prev_meas, prev_flags = {}, {}
+    if os.path.exists(args.json):
+        try:
+            with open(args.json) as f:
+                old = json.load(f)
+            for r in old.get("measurements", []):
+                if r.get("images_per_s"):
+                    prev_meas[r["batch"]] = r
+            for r in old.get("flag_sweep", []):
+                if r.get("images_per_s"):
+                    prev_flags[(r.get("preset"), r.get("batch"))] = r
+        except (OSError, ValueError):
+            pass
+    result = {"metric": "resnet50_tpu_profile",
+              "complete": False}  # flipped by the final flush
 
     def flush():
         with open(args.json, "w") as f:
@@ -182,13 +208,25 @@ def main(argv=None) -> None:
 
     if not args.skip_measure:
         result["measurements"] = rows = []
-        rows.extend(measure_tpu(batches, args.timeout, args.iters,
-                                deadline, flush))
+        todo = []
+        for b in batches:
+            if b in prev_meas:
+                rows.append(dict(prev_meas[b], reused_from_previous_run=True))
+            else:
+                todo.append(b)
+        measure_tpu(todo, args.timeout, args.iters, deadline, flush,
+                    out=rows)
         good = [r for r in rows if "step_s" in r and r["step_s"]]
         best = max(good, key=lambda r: r["images_per_s"]) if good else None
         if args.flag_sweep and best:
-            result["flag_sweep"] = sweep_flags(best["batch"], args.timeout,
-                                               args.iters, deadline, flush)
+            result["flag_sweep"] = fs_rows = []
+            for name, flags in FLAG_PRESETS.items():
+                if (name, best["batch"]) in prev_flags:
+                    fs_rows.append(dict(prev_flags[(name, best["batch"])],
+                                        reused_from_previous_run=True))
+            done_names = {r["preset"] for r in fs_rows}
+            sweep_flags(best["batch"], args.timeout, args.iters, deadline,
+                        flush, skip=done_names, out=fs_rows)
             flagged = [r for r in result["flag_sweep"]
                        if r.get("images_per_s")]
             if flagged:
@@ -220,6 +258,7 @@ def main(argv=None) -> None:
             "layers": attribute_cpu(step_s, batch)}
     else:
         result["error"] = "no successful TPU measurement to attribute"
+    result["complete"] = True
     flush()
     print(json.dumps({"written": args.json,
                       "best": best, "attributed": bool(step_s)}))

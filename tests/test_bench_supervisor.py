@@ -123,7 +123,10 @@ def _write_cached(path, **over):
     d = {"metric": "resnet50_imagenet_train_images_per_sec_per_chip",
          "value": 2103.66, "unit": "images/sec/chip", "vs_baseline": 1.0518,
          "batch": 512, "n_chips": 1, "platform": "axon",
-         "measured_at_unix": int(time.time()), "xla_flags": ""}
+         "measured_at_unix": int(time.time()),
+         # must mirror what the supervisor-under-test computes as the
+         # effective flags from ITS inherited environment
+         "xla_flags_effective": os.environ.get("XLA_FLAGS", "")}
     d.update(over)
     path.write_text(json.dumps(d) + "\n")
     return d
@@ -165,6 +168,9 @@ def test_replay_rejects_junk_stale_and_cpu(tmp_path):
         # config mismatch: cached default recipe, requested batch 128 /
         # a flag-sweep variant — another config's number is not an answer
         ({}, {"BIGDL_TPU_BENCH_BATCH": 128}),
+        # ...and the reverse: a batch-64 experiment's number is not an
+        # answer for the default run either
+        ({"batch": 64}, {}),
         ({}, {"BIGDL_TPU_BENCH_XLA_FLAGS":
               "--xla_tpu_enable_latency_hiding_scheduler=true"}),
     ]
