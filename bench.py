@@ -516,10 +516,13 @@ def _run(batch: int) -> None:
         # also leave the result next to the script: if the driver's
         # stdout handling fails, the measurement still lands in the repo
         # (and becomes the supervisor's replay source if the backend is
-        # dead at the driver's report time).  Experiment invocations
-        # (batch override / injected flag presets) opt out so the replay
-        # source only ever holds recipe-shaped measurements.
-        if not os.environ.get("BIGDL_TPU_BENCH_NO_LAST"):
+        # dead at the driver's report time).  Experiment invocations —
+        # batch override, flag injection via either hook, or an explicit
+        # opt-out — must never clobber the recipe measurement the replay
+        # exists to preserve.
+        if not (os.environ.get("BIGDL_TPU_BENCH_NO_LAST")
+                or os.environ.get("BIGDL_TPU_BENCH_BATCH")
+                or os.environ.get("BIGDL_TPU_BENCH_XLA_FLAGS")):
             with open(_bench_last_path(), "w") as f:
                 f.write(line + "\n")
     except OSError:

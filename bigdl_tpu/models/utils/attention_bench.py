@@ -56,7 +56,7 @@ def bench_one(impl: str, seq_len: int, batch: int, heads: int,
     row = {"metric": "flash_causal_train_step", "impl": impl,
            "seq_len": seq_len, "batch": batch, "heads": heads,
            "head_dim": head_dim, "dtype": dtype,
-           "block_q": block_q, "block_k": block_k}
+           "block_q": block_q, "block_k": block_k, "iters": iters}
     try:
         step_s = _step_time(fn, q, k, v, iters=iters)
         row["step_s"] = round(step_s, 5)
@@ -98,27 +98,34 @@ def main(argv=None) -> None:
 
     seq_lens = ([int(s) for s in args.sweep.split(",")]
                 if args.sweep else [args.seqLen])
+    plat = jax.devices()[0].platform
     # resume: a prior sweep killed by a closing backend window left an
     # incremental artifact; reuse its successful same-config rows so
     # repeated short windows make net progress instead of re-measuring
     # the early seq_lens every time (error rows are retried — an OOM
-    # fails again fast, a died-backend row deserves another shot)
+    # fails again fast, a died-backend row deserves another shot).
+    # Rows from another PLATFORM or iteration count are never reused:
+    # a CPU debug sweep must not publish as TPU numbers, and a quick
+    # --iters 1 smoke must not stand in for the production sample.
     prev = {}
     if args.json and os.path.exists(args.json):
         try:
             with open(args.json) as f:
-                for r in json.load(f).get("rows", []):
+                old = json.load(f)
+            if old.get("platform") == plat:
+                for r in old.get("rows", []):
                     if ("step_s" in r and r.get("batch") == args.batch
                             and r.get("heads") == args.heads
                             and r.get("head_dim") == args.headDim
                             and r.get("dtype") == args.dtype
                             and r.get("block_q") == args.blockQ
-                            and r.get("block_k") == args.blockK):
+                            and r.get("block_k") == args.blockK
+                            and r.get("iters") == args.iters):
                         prev[(r.get("seq_len"), r.get("impl"))] = r
         except (OSError, ValueError):
             pass
     rows = []
-    result = {"platform": jax.devices()[0].platform,
+    result = {"platform": plat,
               "device": str(jax.devices()[0]), "rows": rows,
               "complete": False}  # flipped by the final flush
 

@@ -80,7 +80,7 @@ def run_lm_perf(seq_len: int, batch: int, *, vocab: int = 32000,
             "seq_len": seq_len, "batch": batch, "vocab": vocab,
             "hidden": hidden, "heads": heads, "layers": layers,
             "flash": flash, "remat": remat, "optim": optim, "dtype": dtype,
-            "step_s": round(dt_s, 5),
+            "iters": iters, "step_s": round(dt_s, 5),
             "tokens_per_s": round(batch * seq_len / dt_s, 1)}
 
 
@@ -124,26 +124,32 @@ def main(argv=None) -> None:
             iters=args.iteration)))
         return
 
+    plat = jax.devices()[0].platform
     # resume: reuse successful same-config rows from a prior killed
-    # sweep so repeated short backend windows make net progress
+    # sweep so repeated short backend windows make net progress.  Rows
+    # from another platform or iteration count never qualify (a CPU
+    # debug sweep must not publish as TPU numbers).
     prev = {}
     if args.json and os.path.exists(args.json):
         try:
             with open(args.json) as f:
-                for r in json.load(f).get("rows", []):
+                old = json.load(f)
+            if old.get("platform") == plat:
+                for r in old.get("rows", []):
                     if ("tokens_per_s" in r and r.get("vocab") == args.vocab
                             and r.get("hidden") == args.hidden
                             and r.get("heads") == args.heads
                             and r.get("layers") == args.layers
                             and r.get("remat") == args.remat
                             and r.get("optim") == args.optim
-                            and r.get("dtype") == args.dtype):
+                            and r.get("dtype") == args.dtype
+                            and r.get("iters") == args.iteration):
                         prev[(r.get("seq_len"), r.get("flash"),
                               r.get("batch"))] = r
         except (OSError, ValueError):
             pass
     rows = []
-    result = {"platform": jax.devices()[0].platform, "rows": rows,
+    result = {"platform": plat, "rows": rows,
               "complete": False}  # flipped by the final flush
 
     def flush():

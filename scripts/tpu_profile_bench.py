@@ -49,7 +49,8 @@ def _measure_one(batch: int, timeout: float, iters: int,
             env=env, capture_output=True, text=True, timeout=timeout)
     except subprocess.TimeoutExpired:
         return {"batch": batch, "error": f"timeout {timeout:.0f}s"}
-    row = {"batch": batch, "wall_s": round(time.time() - t0, 1)}
+    row = {"batch": batch, "iters": iters,
+           "wall_s": round(time.time() - t0, 1)}
     if proc.returncode == 0:
         for line in reversed(proc.stdout.strip().splitlines()):
             try:
@@ -182,29 +183,40 @@ def main(argv=None) -> None:
 
     deadline = time.time() + args.deadline
     batches = [int(b) for b in args.batches.split(",")]
+    sys.path.insert(0, REPO)
+    # the inner bench runs on the default platform unless the escape
+    # hatch redirects it; resume must never mix rows across platforms
+    inner_platform = os.environ.get("BIGDL_TPU_BENCH_PLATFORM", "default")
     # resume: reuse successful rows from a prior killed run so repeated
-    # short backend windows make net progress (keyed by batch for the
-    # sweep, by preset+batch for the flag experiments)
+    # short backend windows make net progress (keyed by batch+iters for
+    # the sweep, by preset+flagstring+batch for the flag experiments —
+    # an edited preset definition must be re-measured, not answered
+    # with the old flags' number)
     prev_meas, prev_flags = {}, {}
     if os.path.exists(args.json):
         try:
             with open(args.json) as f:
                 old = json.load(f)
-            for r in old.get("measurements", []):
-                if r.get("images_per_s"):
-                    prev_meas[r["batch"]] = r
-            for r in old.get("flag_sweep", []):
-                if r.get("images_per_s"):
-                    prev_flags[(r.get("preset"), r.get("batch"))] = r
+            if old.get("inner_platform", "default") == inner_platform:
+                for r in old.get("measurements", []):
+                    if r.get("images_per_s") and r.get("iters") == args.iters:
+                        prev_meas[r["batch"]] = r
+                for r in old.get("flag_sweep", []):
+                    if r.get("images_per_s") and r.get("iters") == args.iters:
+                        prev_flags[(r.get("preset"), r.get("xla_flags"),
+                                    r.get("batch"))] = r
         except (OSError, ValueError):
             pass
     result = {"metric": "resnet50_tpu_profile",
+              "inner_platform": inner_platform,
               "complete": False}  # flipped by the final flush
 
     def flush():
-        with open(args.json, "w") as f:
-            json.dump(result, f, indent=2)
-            f.write("\n")
+        from bigdl_tpu.utils import fs
+        # atomic: a kill mid-write must not leave truncated JSON that
+        # zeroes out the resume progress this file exists to carry
+        fs.atomic_write(args.json,
+                        (json.dumps(result, indent=2) + "\n").encode())
 
     if not args.skip_measure:
         result["measurements"] = rows = []
@@ -221,8 +233,9 @@ def main(argv=None) -> None:
         if args.flag_sweep and best:
             result["flag_sweep"] = fs_rows = []
             for name, flags in FLAG_PRESETS.items():
-                if (name, best["batch"]) in prev_flags:
-                    fs_rows.append(dict(prev_flags[(name, best["batch"])],
+                key = (name, flags, best["batch"])
+                if key in prev_flags:
+                    fs_rows.append(dict(prev_flags[key],
                                         reused_from_previous_run=True))
             done_names = {r["preset"] for r in fs_rows}
             sweep_flags(best["batch"], args.timeout, args.iters, deadline,
@@ -258,7 +271,15 @@ def main(argv=None) -> None:
             "layers": attribute_cpu(step_s, batch)}
     else:
         result["error"] = "no successful TPU measurement to attribute"
-    result["complete"] = True
+    # complete means "every configured row got a real attempt": rows the
+    # deadline skipped or that timed out (backend window closed) leave
+    # the artifact incomplete so an opportunistic re-run fills them;
+    # genuine failures (OOM-class) count as attempted
+    unattempted = [
+        r for r in (result.get("measurements", [])
+                    + result.get("flag_sweep", []))
+        if str(r.get("error", "")).startswith(("skipped:", "timeout"))]
+    result["complete"] = not unattempted
     flush()
     print(json.dumps({"written": args.json,
                       "best": best, "attributed": bool(step_s)}))

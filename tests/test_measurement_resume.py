@@ -1,0 +1,156 @@
+"""Cross-window resume for the measurement sweeps (round 4).
+
+The tunneled TPU backend has short windows of availability; the sweep
+CLIs therefore rewrite their artifact after every row and, on restart,
+reuse successful same-configuration rows.  These tests lock the resume
+matching: reuse must hit only when the full configuration matches, and
+error rows must be retried, not reused.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), os.pardir)
+
+
+def _run(mod, *argv, timeout=600):
+    env = dict(os.environ)
+    env["BIGDL_TPU_PLATFORM"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", mod, *map(str, argv)], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=timeout)
+
+
+ATTN_ARGS = ("--sweep", "64,128", "--naive", "--iters", "1", "-b", "1",
+             "--heads", "2", "--headDim", "64")
+
+
+@pytest.mark.slow
+def test_attention_sweep_resumes_same_config(tmp_path):
+    art = tmp_path / "attn.json"
+    p = _run("bigdl_tpu.models.utils.attention_bench", *ATTN_ARGS,
+             "--json", art)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads(art.read_text())
+    assert d["complete"] and len(d["rows"]) == 4
+    assert not any(r.get("reused_from_previous_run") for r in d["rows"])
+
+    # same config again: every row must be reused, nothing re-measured
+    p = _run("bigdl_tpu.models.utils.attention_bench", *ATTN_ARGS,
+             "--json", art)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads(art.read_text())
+    assert d["complete"]
+    assert all(r.get("reused_from_previous_run") for r in d["rows"])
+
+    # different config (head_dim changes): nothing may be reused
+    p = _run("bigdl_tpu.models.utils.attention_bench", "--sweep", "64,128",
+             "--naive", "--iters", "1", "-b", "1", "--heads", "2",
+             "--headDim", "32", "--json", art)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads(art.read_text())
+    assert not any(r.get("reused_from_previous_run") for r in d["rows"])
+
+    # rows recorded on another platform (e.g. a real-TPU artifact being
+    # extended after a CPU debug run, or vice versa): never reused
+    d["platform"] = "axon"
+    art.write_text(json.dumps(d))
+    p = _run("bigdl_tpu.models.utils.attention_bench", "--sweep", "64,128",
+             "--naive", "--iters", "1", "-b", "1", "--heads", "2",
+             "--headDim", "32", "--json", art)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads(art.read_text())
+    assert d["platform"] == "cpu"
+    assert not any(r.get("reused_from_previous_run") for r in d["rows"])
+
+
+@pytest.mark.slow
+def test_attention_partial_artifact_extends(tmp_path):
+    """A partial artifact (window closed mid-sweep) keeps its measured
+    rows and the next run fills only the gap."""
+    art = tmp_path / "attn.json"
+    p = _run("bigdl_tpu.models.utils.attention_bench", "--sweep", "64",
+             "--naive", "--iters", "1", "-b", "1", "--heads", "2",
+             "--headDim", "64", "--json", art)
+    assert p.returncode == 0, p.stderr[-800:]
+    # simulate the kill: mark incomplete (rows stay)
+    d = json.loads(art.read_text())
+    d["complete"] = False
+    art.write_text(json.dumps(d))
+
+    p = _run("bigdl_tpu.models.utils.attention_bench", *ATTN_ARGS,
+             "--json", art)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads(art.read_text())
+    assert d["complete"] and len(d["rows"]) == 4
+    reused = {(r["seq_len"], r["impl"])
+              for r in d["rows"] if r.get("reused_from_previous_run")}
+    assert reused == {(64, "flash"), (64, "naive_xla")}
+
+
+@pytest.mark.slow
+def test_lm_sweep_resumes_and_error_rows_retry(tmp_path):
+    art = tmp_path / "lm.json"
+    args = ("--sweep", "32,64", "-b", "2", "-t", "32", "--vocab", "64",
+            "--hidden", "16", "--heads", "2", "--layers", "1", "-i", "1")
+    p = _run("bigdl_tpu.models.utils.lm_perf", *args, "--json", art)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads(art.read_text())
+    assert d["complete"] and len(d["rows"]) == 4
+
+    # poison one row into an error: it must be re-measured, others reused
+    d["rows"][0] = {"seq_len": d["rows"][0]["seq_len"],
+                    "flash": d["rows"][0]["flash"], "error": "backend died"}
+    d["complete"] = False
+    art.write_text(json.dumps(d))
+    p = _run("bigdl_tpu.models.utils.lm_perf", *args, "--json", art)
+    assert p.returncode == 0, p.stderr[-800:]
+    d = json.loads(art.read_text())
+    assert d["complete"]
+    assert sum(1 for r in d["rows"] if r.get("reused_from_previous_run")) == 3
+    assert all("tokens_per_s" in r for r in d["rows"])
+
+
+def test_profile_resume_skips_measured_batches(tmp_path):
+    """Seeded artifact rows short-circuit the expensive subprocess
+    measurements entirely (pure resume-logic test: every batch and
+    every flag preset already has a successful row, so the run must
+    finish without launching a single inner bench)."""
+    art = tmp_path / "prof.json"
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from tpu_profile_bench import FLAG_PRESETS
+    seed = {
+        "metric": "resnet50_tpu_profile", "complete": False,
+        "inner_platform": "default",
+        "measurements": [
+            {"batch": 256, "iters": 20, "images_per_s": 1900.0,
+             "step_s": 0.1347, "mfu": 0.12},
+            {"batch": 512, "iters": 20, "images_per_s": 2100.0,
+             "step_s": 0.2438, "mfu": 0.13}],
+        # resume requires the recorded flag string to match the preset's
+        # CURRENT definition — an edited preset must be re-measured
+        "flag_sweep": [
+            {"preset": p, "batch": 512, "iters": 20,
+             "images_per_s": 2100.0 + i, "step_s": 0.24, "xla_flags": fl}
+            for i, (p, fl) in enumerate(FLAG_PRESETS.items())],
+    }
+    art.write_text(json.dumps(seed))
+    env = dict(os.environ)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "tpu_profile_bench.py"),
+         "--batches", "256,512", "--flag-sweep", "--deadline", "60",
+         "--json", art, "--assume-step-s", "0.24"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-800:]
+    d = json.loads(art.read_text())
+    assert d["complete"]
+    assert all(r.get("reused_from_previous_run")
+               for r in d["measurements"])
+    assert all(r.get("reused_from_previous_run")
+               for r in d["flag_sweep"])
+    # best_preset computed from the reused rows, with its provenance
+    assert d["best_preset"]["preset"] == "scoped_vmem_32m"
+    assert d["best_preset"]["baseline_source"] == "flag_sweep_baseline"
