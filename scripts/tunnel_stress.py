@@ -1,0 +1,61 @@
+"""Progressive-transfer stress probe for the tunneled TPU backend.
+
+Round-4 post-mortem (NOTES_r4.md): the relay died at the exact moment
+the bench pushed its first large single-buffer host->device transfer.
+This probe binary-searches the tunnel's pain threshold the next time a
+window opens: device_put of doubling sizes with a hard sync and a
+round-trip readback after each, printing one JSON line per step so the
+last line before a hang names the killing size.
+
+    timeout 300 python scripts/tunnel_stress.py            # 1MB..256MB
+    timeout 300 python scripts/tunnel_stress.py --max-mb 64
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--start-mb", type=int, default=1)
+    p.add_argument("--max-mb", type=int, default=256)
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    t0 = time.time()
+    dev = jax.devices()[0]
+    print(json.dumps({"stage": "init", "device": str(dev),
+                      "s": round(time.time() - t0, 2)}), flush=True)
+
+    mb = args.start_mb
+    while mb <= args.max_mb:
+        n = (mb << 20) // 2  # bf16 elements
+        host = np.ones((n,), np.float16)
+        t0 = time.time()
+        arr = jnp.asarray(host, jnp.bfloat16)
+        arr.block_until_ready()
+        up = time.time() - t0
+        t0 = time.time()
+        # readback forces the full round trip (block_until_ready alone
+        # is not trusted on this backend — bench.py:20-22)
+        s = float(arr[::max(1, n // 1024)].astype(jnp.float32).sum())
+        down = time.time() - t0
+        print(json.dumps({"stage": "transfer", "mb": mb,
+                          "upload_s": round(up, 2),
+                          "sync_s": round(down, 2),
+                          "checksum_ok": abs(s - min(n, 1024)) < 2}),
+              flush=True)
+        del arr
+        mb *= 2
+    print(json.dumps({"stage": "done", "verdict":
+                      f"tunnel survived transfers up to {args.max_mb} MB"}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -114,17 +114,21 @@ def test_lm_sweep_resumes_and_error_rows_retry(tmp_path):
     assert all("tokens_per_s" in r for r in d["rows"])
 
 
+@pytest.mark.slow
 def test_profile_resume_skips_measured_batches(tmp_path):
     """Seeded artifact rows short-circuit the expensive subprocess
-    measurements entirely (pure resume-logic test: every batch and
-    every flag preset already has a successful row, so the run must
-    finish without launching a single inner bench)."""
+    measurements entirely (every batch and every flag preset already
+    has a successful row, so the run must finish without launching a
+    single inner bench — only the CPU attribution pass runs).  slow:
+    the attribution compiles every ResNet-50 layer on CPU; and should
+    resume matching ever regress, the pinned-cpu inner bench fails via
+    the subprocess timeout rather than touching a real backend."""
     art = tmp_path / "prof.json"
     sys.path.insert(0, os.path.join(REPO, "scripts"))
     from tpu_profile_bench import FLAG_PRESETS
     seed = {
         "metric": "resnet50_tpu_profile", "complete": False,
-        "inner_platform": "default",
+        "inner_platform": "cpu",
         "measurements": [
             {"batch": 256, "iters": 20, "images_per_s": 1900.0,
              "step_s": 0.1347, "mfu": 0.12},
@@ -139,6 +143,7 @@ def test_profile_resume_skips_measured_batches(tmp_path):
     }
     art.write_text(json.dumps(seed))
     env = dict(os.environ)
+    env["BIGDL_TPU_BENCH_PLATFORM"] = "cpu"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "tpu_profile_bench.py"),
          "--batches", "256,512", "--flag-sweep", "--deadline", "60",
