@@ -61,19 +61,34 @@ run_stage() {  # run_stage <name> <artifact> <budget> <cmd...>
 }
 
 say "opportunist start"
+# Bonus stages (scan experiment, tunnel stress) are diagnostics: they
+# get a bounded number of firings and never gate the round's exit — a
+# stress probe that keeps wedging the tunnel must not consume every
+# future window or block the scaling regeneration.
+scan_tries=0
+stress_tries=0
+regen_done=0
 while :; do
   all_done=1
   for probe_art in BENCH_LAST.json BENCH_ATTN.json BENCH_LM.json \
                    BENCH_PIPELINE.json PROFILE_TPU.json; do
     ok "$probe_art" || { all_done=0; break; }
   done
-  if [ $all_done -eq 1 ]; then
-    say "all artifacts valid - regenerating scaling predictions"
+  if [ $all_done -eq 1 ] && [ $regen_done -eq 0 ]; then
+    say "all measurement artifacts valid - regenerating scaling predictions"
     cp BENCH_LAST.json BENCH_SMOKE.json
     timeout 600 python scripts/regen_scaling_predictions.py BENCH_SMOKE.json \
       >> "$LOG" 2>&1 || say "scaling regen failed"
-    say "opportunist COMPLETE"
-    exit 0
+    regen_done=1
+  fi
+  if [ $regen_done -eq 1 ]; then
+    bonus_left=0
+    { ok BENCH_SCAN.json || [ $scan_tries -ge 3 ]; } || bonus_left=1
+    { ok TUNNEL_STRESS.json || [ $stress_tries -ge 3 ]; } || bonus_left=1
+    if [ $bonus_left -eq 0 ]; then
+      say "opportunist COMPLETE"
+      exit 0
+    fi
   fi
   if alive; then
     say "chip ALIVE - draining stages"
@@ -81,6 +96,16 @@ while :; do
     # completed one is skipped instantly on later passes.
     BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=20 \
       run_stage bench BENCH_LAST.json 420 python -u bench.py
+    # dispatch-overhead experiment: same step, 8 per device call (the
+    # scan variant never writes BENCH_LAST — different metric); tee to
+    # stderr so the diagnosis lines land in the log, not just the tail
+    if ! ok BENCH_SCAN.json && [ $scan_tries -lt 3 ]; then
+      scan_tries=$((scan_tries + 1))
+      run_stage scan BENCH_SCAN.json 420 bash -c \
+        'BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=3 \
+         BIGDL_TPU_BENCH_SCAN_STEPS=8 python -u bench.py \
+         | tee /dev/stderr | tail -1 > BENCH_SCAN.json'
+    fi
     run_stage attention BENCH_ATTN.json 900 \
       python -u -m bigdl_tpu.models.utils.attention_bench \
         --sweep 2048,8192,16384,32768 --naive --iters 5 --json BENCH_ATTN.json
@@ -95,6 +120,17 @@ while :; do
       python -u scripts/tpu_profile_bench.py \
         --batches 256,512,1024 --iters 15 --flag-sweep --deadline 1100 \
         --timeout 500 --json PROFILE_TPU.json
+    # LAST on purpose: if one big framed transfer is what kills the
+    # relay (NOTES_r4 post-mortem), this probe is a tunnel-killer by
+    # design — it must never run before the measurements it would cost.
+    # It only fires at all once every measurement artifact is in.
+    if [ $all_done -eq 1 ] && ! ok TUNNEL_STRESS.json \
+        && [ $stress_tries -lt 3 ]; then
+      stress_tries=$((stress_tries + 1))
+      run_stage stress TUNNEL_STRESS.json 600 \
+        python -u scripts/tunnel_stress.py --max-mb 256 \
+          --json TUNNEL_STRESS.json
+    fi
   else
     say "probe: dead"
     sleep 20

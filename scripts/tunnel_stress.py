@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 
@@ -21,18 +22,68 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--start-mb", type=int, default=1)
     p.add_argument("--max-mb", type=int, default=256)
+    p.add_argument("--json", default=None,
+                   help="artifact path, rewritten after every step so a "
+                        "killed tunnel still leaves the last good size")
     args = p.parse_args(argv)
 
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    rows = []
+    result = {"metric": "tunnel_transfer_stress", "rows": rows,
+              "complete": False, "retries": {}}
+    start_mb = args.start_mb
+    # resume: don't re-send sizes already proven good (each re-send of
+    # the killer size costs a whole availability window), and after the
+    # same size has wedged the tunnel twice, stop — "wedged at N MB" IS
+    # the experiment's answer.
+    if args.json and os.path.exists(args.json):
+        try:
+            with open(args.json) as f:
+                old = json.load(f)
+            good = [r["mb"] for r in old.get("rows", [])
+                    if r.get("checksum_ok")]
+            rows.extend(r for r in old.get("rows", [])
+                        if r.get("checksum_ok"))
+            result["retries"] = {str(k): v for k, v in
+                                 old.get("retries", {}).items()}
+            if good:
+                start_mb = max(good) * 2
+        except (OSError, ValueError):
+            pass
+
+    def flush():
+        if args.json:
+            with open(args.json + ".tmp", "w") as f:
+                json.dump(result, f, indent=2)
+                f.write("\n")
+            os.replace(args.json + ".tmp", args.json)
+
+    killer = str(start_mb)
+    tries = int(result["retries"].get(killer, 0))
+    if start_mb <= args.max_mb and tries >= 2:
+        result["complete"] = True
+        result["verdict"] = (f"tunnel wedges at {start_mb} MB "
+                             f"(killed the probe {tries} times); "
+                             f"largest good transfer "
+                             f"{start_mb // 2} MB")
+        flush()
+        print(json.dumps({"stage": "done", "verdict": result["verdict"]}),
+              flush=True)
+        return
+    result["retries"][killer] = tries + 1
+
     t0 = time.time()
     dev = jax.devices()[0]
+    result["device"] = str(dev)
+    result["init_s"] = round(time.time() - t0, 2)
     print(json.dumps({"stage": "init", "device": str(dev),
-                      "s": round(time.time() - t0, 2)}), flush=True)
+                      "s": result["init_s"]}), flush=True)
+    flush()
 
-    mb = args.start_mb
+    mb = start_mb
     while mb <= args.max_mb:
         n = (mb << 20) // 2  # bf16 elements
         host = np.ones((n,), np.float16)
@@ -45,15 +96,18 @@ def main(argv=None) -> None:
         # is not trusted on this backend — bench.py:20-22)
         s = float(arr[::max(1, n // 1024)].astype(jnp.float32).sum())
         down = time.time() - t0
-        print(json.dumps({"stage": "transfer", "mb": mb,
-                          "upload_s": round(up, 2),
-                          "sync_s": round(down, 2),
-                          "checksum_ok": abs(s - min(n, 1024)) < 2}),
-              flush=True)
+        row = {"stage": "transfer", "mb": mb,
+               "upload_s": round(up, 2), "sync_s": round(down, 2),
+               "checksum_ok": abs(s - min(n, 1024)) < 2}
+        rows.append(row)
+        flush()
+        print(json.dumps(row), flush=True)
         del arr
         mb *= 2
-    print(json.dumps({"stage": "done", "verdict":
-                      f"tunnel survived transfers up to {args.max_mb} MB"}),
+    result["complete"] = True
+    result["verdict"] = f"tunnel survived transfers up to {args.max_mb} MB"
+    flush()
+    print(json.dumps({"stage": "done", "verdict": result["verdict"]}),
           flush=True)
 
 

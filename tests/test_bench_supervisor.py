@@ -171,6 +171,8 @@ def test_replay_rejects_junk_stale_and_cpu(tmp_path):
         # ...and the reverse: a batch-64 experiment's number is not an
         # answer for the default run either
         ({"batch": 64}, {}),
+        # a scanned-dispatch measurement is a different metric
+        ({"scan_steps": 8}, {}),
         ({}, {"BIGDL_TPU_BENCH_XLA_FLAGS":
               "--xla_tpu_enable_latency_hiding_scheduler=true"}),
     ]
