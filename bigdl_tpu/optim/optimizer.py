@@ -368,15 +368,32 @@ class LocalOptimizer(Optimizer):
 
         records_this_epoch = self.state.get("records_processed", 0)
         wall0 = time.perf_counter()
+        # host/device overlap: jit dispatch is async, so the expensive
+        # host work for the NEXT batch (decode/augment/stack) runs while
+        # the device executes the current step; the loss fetch below is
+        # the only sync point.  Without this the loop serializes host
+        # and device time (the chip idles during every batch prep).
+        overlap = os.environ.get("BIGDL_TPU_PREFETCH_OVERLAP", "1") == "1"
+        next_batch = None
         while not self.end_when(self.state):
             self.state["epoch_finished"] = False
-            batch = next(data_iter)
+            batch = next_batch if next_batch is not None else next(data_iter)
+            next_batch = None
             rng, sub = jax.random.split(rng)
             t0 = time.perf_counter()
             params, buffers, opt_state, loss = self._step_fn(
                 params, buffers, opt_state,
                 jnp.asarray(batch.data), jnp.asarray(batch.labels), sub,
                 self.state["epoch"])
+            bs_now = batch.data.shape[0]
+            if overlap and records_this_epoch + bs_now < dataset_size:
+                # fetched one step ahead so host decode hides under the
+                # device step.  NOT at an epoch boundary: the prefetch
+                # would wrap the infinite iterator onto the OLD
+                # permutation before the rollover shuffle() below runs,
+                # silently replaying last epoch's record order — one
+                # serialized iteration per epoch is the correct price
+                next_batch = next(data_iter)
             loss_val = float(loss)  # syncs; also what the reference logs
             dt = time.perf_counter() - t0
             bs = batch.data.shape[0]

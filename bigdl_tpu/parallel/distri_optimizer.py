@@ -31,6 +31,7 @@ DCN across slices — XLA picks the transport from the mesh).
 from __future__ import annotations
 
 import logging
+import os
 import time
 from functools import partial
 from typing import Optional, Sequence
@@ -269,11 +270,15 @@ class DistriOptimizer(Optimizer):
         data_iter = self.dataset.data(train=True)
         records_this_epoch = self.state.get("records_processed", 0)
         wall0 = time.perf_counter()
+        # host/device overlap (see LocalOptimizer): fetch + place the
+        # NEXT batch between issuing the step and syncing on its loss,
+        # so host decode and h2d placement hide under device compute.
+        # The prefetch carries no collectives, so the multi-host
+        # collective order is untouched.
+        overlap = os.environ.get("BIGDL_TPU_PREFETCH_OVERLAP", "1") == "1"
 
-        while not self.end_when(self.state):
-            self.state["epoch_finished"] = False
+        def fetch_and_place():
             batch = next(data_iter)
-            local_bs = batch.data.shape[0]
             t_shard = time.perf_counter()
             data = _shard_batch(self.mesh, np.asarray(batch.data))
             labels = _shard_batch(self.mesh, np.asarray(batch.labels))
@@ -281,6 +286,17 @@ class DistriOptimizer(Optimizer):
             # analog of the reference's per-phase Metrics,
             # optim/DistriOptimizer.scala:115-119)
             self.metrics.add("shard data time", time.perf_counter() - t_shard)
+            return batch, data, labels
+
+        next_ready = None
+        while not self.end_when(self.state):
+            self.state["epoch_finished"] = False
+            if next_ready is not None:
+                batch, data, labels = next_ready
+                next_ready = None
+            else:
+                batch, data, labels = fetch_and_place()
+            local_bs = batch.data.shape[0]
             rng, sub = jax.random.split(rng)
             if self._step_avals is None:
                 # shape/dtype/sharding snapshot so collective_footprint()
@@ -310,6 +326,15 @@ class DistriOptimizer(Optimizer):
             w_shards, opt_state, buffers, loss = step_fn(
                 w_shards, opt_state, buffers, data, labels, sub,
                 self.state["epoch"])
+            global_bs_now = local_bs * jax.process_count()
+            if (overlap and records_this_epoch + global_bs_now
+                    < global_dataset_size):
+                # hides under the step; skipped at the epoch boundary so
+                # the prefetch cannot wrap the iterator onto the old
+                # permutation before the rollover shuffle() runs (see
+                # LocalOptimizer), and a maxEpoch ending never fetches
+                # and places a batch it will throw away
+                next_ready = fetch_and_place()
             loss_val = float(loss)
             dt = time.perf_counter() - t0
             global_bs = local_bs * jax.process_count()

@@ -98,8 +98,11 @@ while :; do
       run_stage bench BENCH_LAST.json 420 python -u bench.py
     # dispatch-overhead experiment: same step, 8 per device call (the
     # scan variant never writes BENCH_LAST — different metric); tee to
-    # stderr so the diagnosis lines land in the log, not just the tail
-    if ! ok BENCH_SCAN.json && [ $scan_tries -lt 3 ]; then
+    # stderr so the diagnosis lines land in the log, not just the tail.
+    # Bonus diagnostics only fire once every measurement artifact is in
+    # — they must never spend a scarce window the measurements need.
+    if [ $all_done -eq 1 ] && ! ok BENCH_SCAN.json \
+        && [ $scan_tries -lt 3 ]; then
       scan_tries=$((scan_tries + 1))
       run_stage scan BENCH_SCAN.json 420 bash -c \
         'BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=3 \
@@ -132,6 +135,13 @@ while :; do
           --json TUNNEL_STRESS.json
     fi
   else
+    if [ $regen_done -eq 1 ]; then
+      # measurements + regen are in and the backend is dead: done.  The
+      # bonus diagnostics are only worth another window if one opens on
+      # its own — they never justify holding the round open.
+      say "measurements complete, backend dead - exiting without bonus"
+      exit 0
+    fi
     say "probe: dead"
     sleep 20
   fi

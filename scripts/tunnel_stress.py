@@ -35,22 +35,21 @@ def main(argv=None) -> None:
     result = {"metric": "tunnel_transfer_stress", "rows": rows,
               "complete": False, "retries": {}}
     start_mb = args.start_mb
-    # resume: don't re-send sizes already proven good (each re-send of
-    # the killer size costs a whole availability window), and after the
-    # same size has wedged the tunnel twice, stop — "wedged at N MB" IS
-    # the experiment's answer.
+    # resume: don't re-send sizes already attempted (each re-send of the
+    # killer size costs a whole availability window).  ALL prior rows
+    # are retained — a corrupted-but-survived transfer is exactly the
+    # evidence this probe exists to collect — and after the same size
+    # has wedged the tunnel twice, stop: "wedged at N MB" IS the answer.
     if args.json and os.path.exists(args.json):
         try:
             with open(args.json) as f:
                 old = json.load(f)
-            good = [r["mb"] for r in old.get("rows", [])
-                    if r.get("checksum_ok")]
-            rows.extend(r for r in old.get("rows", [])
-                        if r.get("checksum_ok"))
-            result["retries"] = {str(k): v for k, v in
+            prior = old.get("rows", [])
+            rows.extend(prior)
+            result["retries"] = {str(k): int(v) for k, v in
                                  old.get("retries", {}).items()}
-            if good:
-                start_mb = max(good) * 2
+            if prior:
+                start_mb = max(r["mb"] for r in prior) * 2
         except (OSError, ValueError):
             pass
 
@@ -61,19 +60,17 @@ def main(argv=None) -> None:
                 f.write("\n")
             os.replace(args.json + ".tmp", args.json)
 
-    killer = str(start_mb)
-    tries = int(result["retries"].get(killer, 0))
+    tries = int(result["retries"].get(str(start_mb), 0))
     if start_mb <= args.max_mb and tries >= 2:
         result["complete"] = True
         result["verdict"] = (f"tunnel wedges at {start_mb} MB "
                              f"(killed the probe {tries} times); "
-                             f"largest good transfer "
+                             f"largest completed transfer "
                              f"{start_mb // 2} MB")
         flush()
         print(json.dumps({"stage": "done", "verdict": result["verdict"]}),
               flush=True)
         return
-    result["retries"][killer] = tries + 1
 
     t0 = time.time()
     dev = jax.devices()[0]
@@ -85,6 +82,10 @@ def main(argv=None) -> None:
 
     mb = start_mb
     while mb <= args.max_mb:
+        # book the attempt BEFORE sending: if this size kills the probe,
+        # the artifact must show which size was in flight
+        result["retries"][str(mb)] = int(result["retries"].get(str(mb), 0)) + 1
+        flush()
         n = (mb << 20) // 2  # bf16 elements
         host = np.ones((n,), np.float16)
         t0 = time.time()
