@@ -83,6 +83,13 @@ def main(argv=None) -> None:
                    choices=["bfloat16", "float32"])
     p.add_argument("--naive", action="store_true",
                    help="also time the O(T^2) XLA attention")
+    p.add_argument("--autotune", action="store_true",
+                   help="sweep flash (block_q, block_k) tiles at -t and "
+                        "report the fastest; grid via --tuneGrid")
+    p.add_argument("--tuneGrid", default="128:128,128:256,128:512,"
+                                          "256:256,256:512,512:512,"
+                                          "128:1024,256:1024",
+                   help="comma list of blockQ:blockK pairs for --autotune")
     p.add_argument("--json", default=None,
                    help="write the full sweep to this path")
     args = p.parse_args(argv)
@@ -95,6 +102,10 @@ def main(argv=None) -> None:
 
     Engine.init()  # honors BIGDL_TPU_PLATFORM (sitecustomize pins the
     # platform at interpreter start, so a plain JAX_PLATFORMS is ignored)
+
+    if args.autotune:
+        _autotune(args)
+        return
 
     seq_lens = ([int(s) for s in args.sweep.split(",")]
                 if args.sweep else [args.seqLen])
@@ -154,6 +165,82 @@ def main(argv=None) -> None:
             rows.append(row)
             flush()
             print(json.dumps(row), flush=True)
+    result["complete"] = True
+    flush()
+
+
+def _autotune(args) -> None:
+    """Tile-size sweep for the flash kernels at one sequence length.
+
+    The shipped defaults (128, 128) were chosen for VMEM safety, not
+    measured speed; the right tiles are a hardware property (VMEM size,
+    MXU shape) this one command measures the moment a chip answers:
+
+        python -m bigdl_tpu.models.utils.attention_bench --autotune \\
+            -t 16384 --json TUNE_ATTN.json
+
+    Incremental + resumable like the main sweep: killed mid-grid keeps
+    every measured pair; OOM-class pairs record error rows (a too-big
+    tile failing IS the measurement)."""
+    import os
+
+    import jax
+
+    plat = jax.devices()[0].platform
+    grid = []
+    for pair in args.tuneGrid.split(","):
+        bq, bk = pair.split(":")
+        grid.append((int(bq), int(bk)))
+    prev = {}
+    if args.json and os.path.exists(args.json):
+        try:
+            with open(args.json) as f:
+                old = json.load(f)
+            if (old.get("platform") == plat
+                    and old.get("seq_len") == args.seqLen
+                    and old.get("config") == [args.batch, args.heads,
+                                              args.headDim, args.dtype,
+                                              args.iters]):
+                for r in old.get("rows", []):
+                    if "step_s" in r:
+                        prev[(r["block_q"], r["block_k"])] = r
+        except (OSError, ValueError):
+            pass
+    rows = []
+    result = {"metric": "flash_attention_tile_autotune",
+              "platform": plat, "seq_len": args.seqLen,
+              "config": [args.batch, args.heads, args.headDim, args.dtype,
+                         args.iters],
+              "rows": rows, "complete": False}
+
+    def flush():
+        good = [r for r in rows if "step_s" in r]
+        if good:
+            best = min(good, key=lambda r: r["step_s"])
+            result["best"] = {"block_q": best["block_q"],
+                              "block_k": best["block_k"],
+                              "step_s": best["step_s"]}
+            base = next((r["step_s"] for r in good
+                         if (r["block_q"], r["block_k"]) == (128, 128)),
+                        None)
+            if base is not None:  # no fabricated 1.0 when unmeasured
+                result["best"]["speedup_vs_128x128"] = round(
+                    base / best["step_s"], 3)
+        if args.json:
+            from bigdl_tpu.utils import fs
+            fs.atomic_write(args.json,
+                            (json.dumps(result, indent=2) + "\n").encode())
+
+    for bq, bk in grid:
+        if (bq, bk) in prev:
+            row = dict(prev[(bq, bk)], reused_from_previous_run=True)
+        else:
+            row = bench_one("flash", args.seqLen, args.batch, args.heads,
+                            args.headDim, args.dtype, iters=args.iters,
+                            block_q=bq, block_k=bk)
+        rows.append(row)
+        flush()
+        print(json.dumps(row), flush=True)
     result["complete"] = True
     flush()
 
