@@ -104,6 +104,9 @@ def main(argv=None) -> None:
     # platform at interpreter start, so a plain JAX_PLATFORMS is ignored)
 
     if args.autotune:
+        if args.sweep:
+            p.error("--autotune tunes at a single -t; it does not iterate "
+                    "--sweep (run it once per length instead)")
         _autotune(args)
         return
 
@@ -147,10 +150,7 @@ def main(argv=None) -> None:
         summary = _summarize(rows)
         if summary:
             result["summary"] = summary
-        if args.json:
-            from bigdl_tpu.utils import fs
-            fs.atomic_write(args.json,
-                            (json.dumps(result, indent=2) + "\n").encode())
+        _flush_artifact(args.json, result)
 
     for t in seq_lens:
         for impl in (["flash", "naive_xla"] if args.naive else ["flash"]):
@@ -167,6 +167,23 @@ def main(argv=None) -> None:
             print(json.dumps(row), flush=True)
     result["complete"] = True
     flush()
+
+
+def _is_capacity_error(row: dict) -> bool:
+    """Deterministic won't-ever-fit failures, worth reusing on resume —
+    as opposed to a backend dying mid-compile, which deserves a retry."""
+    err = str(row.get("error", ""))
+    return any(m in err for m in ("RESOURCE_EXHAUSTED", "out of memory",
+                                  "OOM", "vmem", "VMEM", "Mosaic",
+                                  "too large", "exceeds"))
+
+
+def _flush_artifact(path: str, result: dict) -> None:
+    """One atomic-write path for every incremental artifact this module
+    produces (killed sweeps must keep their rows, never truncate)."""
+    if path:
+        from bigdl_tpu.utils import fs
+        fs.atomic_write(path, (json.dumps(result, indent=2) + "\n").encode())
 
 
 def _autotune(args) -> None:
@@ -202,7 +219,10 @@ def _autotune(args) -> None:
                                               args.headDim, args.dtype,
                                               args.iters]):
                 for r in old.get("rows", []):
-                    if "step_s" in r:
+                    if "step_s" in r or _is_capacity_error(r):
+                        # a tile that OOMs/fails VMEM IS a measurement —
+                        # reuse it; transient-looking errors (backend
+                        # died mid-compile) get retried
                         prev[(r["block_q"], r["block_k"])] = r
         except (OSError, ValueError):
             pass
@@ -226,10 +246,7 @@ def _autotune(args) -> None:
             if base is not None:  # no fabricated 1.0 when unmeasured
                 result["best"]["speedup_vs_128x128"] = round(
                     base / best["step_s"], 3)
-        if args.json:
-            from bigdl_tpu.utils import fs
-            fs.atomic_write(args.json,
-                            (json.dumps(result, indent=2) + "\n").encode())
+        _flush_artifact(args.json, result)
 
     for bq, bk in grid:
         if (bq, bk) in prev:
