@@ -30,6 +30,54 @@ from bigdl_tpu.optim.validation import ValidationMethod
 log = logging.getLogger("bigdl_tpu.optim")
 
 
+def accumulated_value_and_grad(loss_fn, accum, params, buffers, data,
+                               labels, rng, batch_desc="batch"):
+    """``(loss, new_buffers), grads`` for one batch, optionally split
+    into ``accum`` equal micro-batches scanned inside the step.
+
+    The mean of the micro-batch gradients equals the full-batch
+    gradient for mean-reduced criteria, while activation memory is that
+    of ONE micro-batch — the scan re-materializes activations per
+    micro-step.  Buffers (BN stats, MoE aux) thread through the scan
+    carry, i.e. sequential small-batch semantics.  Used by both the
+    local and the distributed step builders; inside shard_map the
+    parameter all-gather and gradient reduce-scatter still run once per
+    EFFECTIVE batch (any collectives the model's own loss carries —
+    e.g. the MoE balance-term pmean — do repeat per micro-batch).
+    ``batch_desc`` names the axis in the divisibility error: under
+    shard_map the leading dim is the per-device shard, not the global
+    batch the user configured."""
+    vag = jax.value_and_grad(loss_fn, has_aux=True)
+    if accum <= 1:
+        return vag(params, buffers, data, labels, rng)
+
+    def resh(x):
+        x = jnp.asarray(x)
+        if x.shape[0] % accum:
+            raise ValueError(
+                f"gradient accumulation needs the {batch_desc} "
+                f"({x.shape[0]}) divisible by n_micro ({accum})")
+        return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
+
+    data_m, labels_m = resh(data), resh(labels)
+    rngs = jax.random.split(rng, accum)
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def body(carry, xs):
+        g_acc, bufs, l_acc = carry
+        d, l, r = xs
+        (loss, nb), g = vag(params, bufs, d, l, r)
+        g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+        return (g_acc, nb, l_acc + loss.astype(jnp.float32)), None
+
+    (g_sum, new_buffers, loss_sum), _ = jax.lax.scan(
+        body, (zeros, buffers, jnp.zeros((), jnp.float32)),
+        (data_m, labels_m, rngs))
+    inv = 1.0 / accum
+    grads = jax.tree_util.tree_map(lambda g: g * inv, g_sum)
+    return (loss_sum * inv, new_buffers), grads
+
+
 class Optimizer:
     """Builder API (ref optim/Optimizer.scala:29-144).  The factory
     dispatches Local vs Distri on the dataset type, like the reference's
@@ -51,10 +99,30 @@ class Optimizer:
         self.state: dict = {}
         self.metrics = Metrics()
         self.compute_dtype = None  # e.g. jnp.bfloat16; None = full f32
+        self.grad_accum = 1  # micro-batches per step (set_gradient_accumulation)
 
     # -- builder methods (reference names, pythonized) ------------------- #
     def set_optim_method(self, method: OptimMethod) -> "Optimizer":
         self.optim_method = method
+        return self
+
+    def set_gradient_accumulation(self, n_micro: int) -> "Optimizer":
+        """Split every batch into ``n_micro`` equal micro-batches inside
+        the jitted step (``lax.scan``), accumulating gradients before
+        the single optimizer update (and, distributed, the single
+        collective cycle).  Activation memory scales with the
+        MICRO-batch, so effective batches far beyond HBM fit — a
+        capability the reference's executor model has no analog for.
+        Losses/gradients match the full-batch step exactly for
+        mean-reduced criteria; batch-statistics layers (BatchNorm) see
+        micro-batch statistics, matching sequential small-batch
+        semantics.  ``n_micro`` must divide the batch each step body
+        sees — the full batch locally, the PER-DEVICE shard
+        (global batch / devices) under ``DistriOptimizer``."""
+        n_micro = int(n_micro)
+        if n_micro < 1:
+            raise ValueError(f"n_micro must be >= 1, got {n_micro}")
+        self.grad_accum = n_micro
         return self
 
     def set_compute_dtype(self, dtype) -> "Optimizer":
@@ -337,9 +405,11 @@ class LocalOptimizer(Optimizer):
                 loss = loss + new_buffers["aux_loss"]
             return loss, new_buffers
 
+        accum = self.grad_accum
+
         def step(params, buffers, opt_state, data, labels, rng, epoch):
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, buffers, data, labels, rng)
+            (loss, new_buffers), grads = accumulated_value_and_grad(
+                loss_fn, accum, params, buffers, data, labels, rng)
             grads = self._clip_gradients(grads)
             new_params, new_opt_state = method.update(grads, opt_state, params,
                                                       epoch=epoch)
@@ -468,6 +538,14 @@ class LocalOptimizer(Optimizer):
                 "gradient clipping is incompatible with LBFGS (the line "
                 "search and curvature pairs need the true gradient) — "
                 "remove the clipping or use SGD/Adam")
+        if self.grad_accum > 1:
+            # the line search re-evaluates the full-batch loss at trial
+            # points; silently ignoring the accumulation request (and
+            # its memory expectation) would be worse than refusing
+            raise ValueError(
+                "set_gradient_accumulation is not supported with LBFGS "
+                "(the strong-Wolfe line search evaluates the full batch) "
+                "— use SGD/Adam, or drop the accumulation")
 
         def feval(flat):
             v, g = val_and_grad(flat)

@@ -44,7 +44,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.nn.module import Criterion, Module
-from bigdl_tpu.optim.optimizer import Optimizer, Validator
+from bigdl_tpu.optim.optimizer import (Optimizer, Validator,
+                                       accumulated_value_and_grad)
 from bigdl_tpu.optim.validation import ValidationMethod
 from bigdl_tpu.parallel.mesh import DATA_AXIS, data_parallel_mesh
 from bigdl_tpu.parallel.parameters import AllReduceParameter
@@ -129,13 +130,19 @@ class DistriOptimizer(Optimizer):
                 loss = loss + aux
             return loss, new_buffers
 
+        accum = self.grad_accum
+
         def step(w_shard, opt_state, buffers, data, labels, rng, epoch):
             # per-device RNG (each reference thread-replica drew its own noise)
             rng = jax.random.fold_in(rng, lax.axis_index(DATA_AXIS))
             w_full = arp.gather_weights(w_shard)               # bf16 all-gather
             params = arp.unravel(w_full)
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, buffers, data, labels, rng)
+            # the parameter all-gather / gradient reduce-scatter run once
+            # per EFFECTIVE batch regardless of accum (loss-internal
+            # collectives like the MoE balance pmean do repeat per micro)
+            (loss, new_buffers), grads = accumulated_value_and_grad(
+                loss_fn, accum, params, buffers, data, labels, rng,
+                batch_desc="per-device batch (global batch / devices)")
             g_shard = arp.scatter_gradients(grads, mean=True)  # bf16 reduce-scatter
             # clip on the sharded slice with a psum'd global norm — the
             # SPMD form of clip-then-update (each slot owns 1/N of the
