@@ -267,6 +267,11 @@ def main(argv=None) -> None:
                         "afterwards)")
     p.add_argument("--json", default=None)
     args = p.parse_args(argv)
+
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init()  # honors BIGDL_TPU_PLATFORM, like the sibling benches
+
     workdir = args.workdir or tempfile.mkdtemp(prefix="bigdl_tpu_pipebench_")
     cleanup = args.workdir is None
     try:

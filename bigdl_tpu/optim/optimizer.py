@@ -44,19 +44,21 @@ def accumulated_value_and_grad(loss_fn, accum, params, buffers, data,
     parameter all-gather and gradient reduce-scatter still run once per
     EFFECTIVE batch (any collectives the model's own loss carries —
     e.g. the MoE balance-term pmean — do repeat per micro-batch).
-    ``batch_desc`` names the axis in the divisibility error: under
-    shard_map the leading dim is the per-device shard, not the global
-    batch the user configured."""
+    An INDIVISIBLE batch (the ragged tail a drop-last=False batcher
+    emits at epoch end) falls back to one unaccumulated step — the
+    same true mean gradient, briefly at full-batch activation memory;
+    a tail is smaller than the steady batch, so the peak does not grow.
+    Misconfiguration (steady batch itself indivisible) is caught
+    host-side by the optimize loops before any work runs; ``batch_desc``
+    names the axis there (under shard_map the constraint binds the
+    per-device shard, not the global batch)."""
+    del batch_desc  # part of the host-side check's message, not ours
     vag = jax.value_and_grad(loss_fn, has_aux=True)
-    if accum <= 1:
+    if accum <= 1 or jnp.asarray(data).shape[0] % accum:
         return vag(params, buffers, data, labels, rng)
 
     def resh(x):
         x = jnp.asarray(x)
-        if x.shape[0] % accum:
-            raise ValueError(
-                f"gradient accumulation needs the {batch_desc} "
-                f"({x.shape[0]}) divisible by n_micro ({accum})")
         return x.reshape((accum, x.shape[0] // accum) + x.shape[1:])
 
     data_m, labels_m = resh(data), resh(labels)
@@ -445,10 +447,23 @@ class LocalOptimizer(Optimizer):
         # and device time (the chip idles during every batch prep).
         overlap = os.environ.get("BIGDL_TPU_PREFETCH_OVERLAP", "1") == "1"
         next_batch = None
+        accum_checked = False
         while not self.end_when(self.state):
             self.state["epoch_finished"] = False
             batch = next_batch if next_batch is not None else next(data_iter)
             next_batch = None
+            if not accum_checked:
+                # the FIRST batch is the steady size: catching an
+                # indivisible configuration here (before any compile)
+                # beats silently never accumulating; later ragged tail
+                # batches fall back to one unaccumulated step by design
+                accum_checked = True
+                if (self.grad_accum > 1
+                        and batch.data.shape[0] % self.grad_accum):
+                    raise ValueError(
+                        f"set_gradient_accumulation({self.grad_accum}) "
+                        f"needs the batch size ({batch.data.shape[0]}) "
+                        f"divisible by n_micro")
             rng, sub = jax.random.split(rng)
             t0 = time.perf_counter()
             params, buffers, opt_state, loss = self._step_fn(

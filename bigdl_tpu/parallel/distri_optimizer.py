@@ -296,6 +296,7 @@ class DistriOptimizer(Optimizer):
             return batch, data, labels
 
         next_ready = None
+        accum_checked = False
         while not self.end_when(self.state):
             self.state["epoch_finished"] = False
             if next_ready is not None:
@@ -304,6 +305,18 @@ class DistriOptimizer(Optimizer):
             else:
                 batch, data, labels = fetch_and_place()
             local_bs = batch.data.shape[0]
+            if not accum_checked:
+                # first batch = steady size; the constraint binds the
+                # per-device shard (what the shard_map body sees), so a
+                # misconfiguration is named in the user's terms before
+                # any compile; ragged tails later fall back unaccumulated
+                accum_checked = True
+                per_dev = (local_bs * jax.process_count()) // self.n_slots
+                if self.grad_accum > 1 and per_dev % self.grad_accum:
+                    raise ValueError(
+                        f"set_gradient_accumulation({self.grad_accum}) "
+                        f"needs the per-device batch (global batch / "
+                        f"devices = {per_dev}) divisible by n_micro")
             rng, sub = jax.random.split(rng)
             if self._step_avals is None:
                 # shape/dtype/sharding snapshot so collective_footprint()

@@ -19,13 +19,44 @@ LOG="${1:-opportunist.log}"
 
 say() { echo "$(date +%H:%M:%S) $*" >> "$LOG"; }
 
+# BIGDL_TPU_OPPORTUNIST_SMOKE=1: end-to-end rehearsal of THIS script's
+# orchestration (stage sequencing, completeness gates, regen, bonus
+# tiers, exit) on tiny configs — run it on CPU in a scratch clone so
+# the one real availability window never meets an untested code path:
+#
+#   git clone -q /root/repo /tmp/opp_smoke && cd /tmp/opp_smoke && \
+#   BIGDL_TPU_OPPORTUNIST_SMOKE=1 BIGDL_TPU_PLATFORM=cpu \
+#   BIGDL_TPU_BENCH_PLATFORM=cpu bash scripts/chip_opportunist.sh
+SMOKE="${BIGDL_TPU_OPPORTUNIST_SMOKE:-0}"
+if [ "$SMOKE" = "1" ]; then
+  BENCH_FLOOR=0.01           # CPU throughput is tiny but real
+  BENCH_ITERS=2
+  export BIGDL_TPU_BENCH_BATCH=8   # inner bench + scan stage pick it up
+  export BIGDL_TPU_BENCH_FORCE_LAST=1  # rehearsal: write despite override
+  ATTN_ARGS="--sweep 128,256 --naive --iters 1 -b 1 --heads 2 --headDim 64"
+  LM_ARGS="--sweep 64,128 -b 2 -t 64 --vocab 100 --hidden 32 --heads 2 --layers 1 -i 1"
+  PIPE_ARGS="--batch 8 --iters 2 --warmup 1 --records 64"
+  PROF_ARGS="--batches 8 --iters 2 --deadline 400 --timeout 380"
+  STRESS_ARGS="--max-mb 4"
+  SCAN_ITERS=1; SCAN_STEPS=2
+else
+  BENCH_FLOOR=100            # a degraded-window crawl is not a result
+  BENCH_ITERS=20
+  ATTN_ARGS="--sweep 2048,8192,16384,32768 --naive --iters 5"
+  LM_ARGS="--sweep 2048,8192,16384 -b 8 -t 2048 --flash --remat -i 5"
+  PIPE_ARGS="--batch 256 --iters 15 --records 2048"
+  PROF_ARGS="--batches 256,512,1024 --iters 15 --flag-sweep --deadline 1100 --timeout 500"
+  STRESS_ARGS="--max-mb 256"
+  SCAN_ITERS=3; SCAN_STEPS=8
+fi
+
 # A stage artifact counts as done when it parses as JSON and carries
 # real data (no top-level "error"; the headline bench must additionally
 # clear a sanity floor so a degraded-window crawl — e.g. one step
 # completing at 0.12 img/s before the backend died — can never
 # permanently mark the stage DONE and poison the scaling regeneration).
 ok() {  # ok <file>
-  python - "$1" <<'PYEOF'
+  OK_BENCH_FLOOR="$BENCH_FLOOR" python - "$1" <<'PYEOF'
 import json, sys
 try:
     d = json.load(open(sys.argv[1]))
@@ -35,15 +66,23 @@ if isinstance(d, dict) and d.get("error"):
     sys.exit(1)
 if isinstance(d, dict) and d.get("complete") is False:
     sys.exit(1)  # incremental artifact from a killed sweep: keep firing
+import os
+floor = float(os.environ.get("OK_BENCH_FLOOR", "100"))
 if isinstance(d, dict) and "value" in d:
-    if not d.get("value") or d["value"] < 100:
+    if not d.get("value") or d["value"] < floor:
         sys.exit(1)
 sys.exit(0)
 PYEOF
 }
 
 alive() {
-  timeout 30 python -u -c "import jax; jax.devices()" >/dev/null 2>&1
+  timeout 30 python -u -c "
+import os
+import jax
+p = os.environ.get('BIGDL_TPU_PLATFORM')
+if p:
+    jax.config.update('jax_platforms', p)  # smoke rehearsal runs on CPU
+jax.devices()" >/dev/null 2>&1
 }
 
 run_stage() {  # run_stage <name> <artifact> <budget> <cmd...>
@@ -94,35 +133,37 @@ while :; do
     say "chip ALIVE - draining stages"
     # Highest value first; each stage re-checks its own artifact so a
     # completed one is skipped instantly on later passes.
-    BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=20 \
+    BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=$BENCH_ITERS \
       run_stage bench BENCH_LAST.json 420 python -u bench.py
-    # dispatch-overhead experiment: same step, 8 per device call (the
-    # scan variant never writes BENCH_LAST — different metric); tee to
-    # stderr so the diagnosis lines land in the log, not just the tail.
-    # Bonus diagnostics only fire once every measurement artifact is in
-    # — they must never spend a scarce window the measurements need.
+    # dispatch-overhead experiment: same step, SCAN_STEPS per device
+    # call (the scan variant never writes BENCH_LAST — different
+    # metric); tee to stderr so the diagnosis lines land in the log,
+    # not just the tail.  Bonus diagnostics only fire once every
+    # measurement artifact is in — they must never spend a scarce
+    # window the measurements need.
     if [ $all_done -eq 1 ] && ! ok BENCH_SCAN.json \
         && [ $scan_tries -lt 3 ]; then
       scan_tries=$((scan_tries + 1))
-      run_stage scan BENCH_SCAN.json 420 bash -c \
-        'BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=3 \
-         BIGDL_TPU_BENCH_SCAN_STEPS=8 python -u bench.py \
-         | tee /dev/stderr | tail -1 > BENCH_SCAN.json'
+      BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=$SCAN_ITERS \
+        BIGDL_TPU_BENCH_SCAN_STEPS=$SCAN_STEPS \
+        run_stage scan BENCH_SCAN.json 420 bash -c \
+          'python -u bench.py | tee -a /dev/stderr | tail -1 > BENCH_SCAN.json'
+          # tee -a: /dev/stderr points at the log FILE here, and a
+          # fresh non-append open would rewind it to offset 0 and
+          # overwrite the whole log (it did, in the smoke rehearsal)
     fi
     run_stage attention BENCH_ATTN.json 900 \
       python -u -m bigdl_tpu.models.utils.attention_bench \
-        --sweep 2048,8192,16384,32768 --naive --iters 5 --json BENCH_ATTN.json
+        $ATTN_ARGS --json BENCH_ATTN.json
     run_stage lm BENCH_LM.json 900 \
       python -u -m bigdl_tpu.models.utils.lm_perf \
-        --sweep 2048,8192,16384 -b 8 -t 2048 --flash --remat -i 5 \
-        --json BENCH_LM.json
+        $LM_ARGS --json BENCH_LM.json
     run_stage pipeline BENCH_PIPELINE.json 600 \
       python -u -m bigdl_tpu.models.utils.pipeline_bench \
-        --batch 256 --iters 15 --records 2048 --json BENCH_PIPELINE.json
+        $PIPE_ARGS --json BENCH_PIPELINE.json
     run_stage profile PROFILE_TPU.json 1200 \
       python -u scripts/tpu_profile_bench.py \
-        --batches 256,512,1024 --iters 15 --flag-sweep --deadline 1100 \
-        --timeout 500 --json PROFILE_TPU.json
+        $PROF_ARGS --json PROFILE_TPU.json
     # LAST on purpose: if one big framed transfer is what kills the
     # relay (NOTES_r4 post-mortem), this probe is a tunnel-killer by
     # design — it must never run before the measurements it would cost.
@@ -131,7 +172,7 @@ while :; do
         && [ $stress_tries -lt 3 ]; then
       stress_tries=$((stress_tries + 1))
       run_stage stress TUNNEL_STRESS.json 600 \
-        python -u scripts/tunnel_stress.py --max-mb 256 \
+        python -u scripts/tunnel_stress.py $STRESS_ARGS \
           --json TUNNEL_STRESS.json
     fi
   else

@@ -71,8 +71,12 @@ def main() -> None:
     sys.path.insert(0, repo)
     bench_path = sys.argv[1] if len(sys.argv) > 1 else "BENCH_SMOKE.json"
     step_s, result = bench_step_seconds(bench_path)
-    src = (f"measured (real {os.environ.get('PALLAS_AXON_TPU_GEN', 'tpu')} "
-           f"chip, bench.py: {result['value']} img/s at batch "
+    # provenance derives from the artifact itself: a cpu-platform bench
+    # (smoke rehearsals) must never be labeled as chip-measured
+    plat = result.get("platform", "")
+    hw = ("cpu host (NOT a chip measurement)" if plat == "cpu" else
+          f"real {os.environ.get('PALLAS_AXON_TPU_GEN', 'tpu')} chip")
+    src = (f"measured ({hw}, bench.py: {result['value']} img/s at batch "
            f"{result.get('batch')})")
     print(f"bench step time: {step_s:.4f}s  [{src}]")
 

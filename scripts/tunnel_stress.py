@@ -27,6 +27,14 @@ def main(argv=None) -> None:
                         "killed tunnel still leaves the last good size")
     args = p.parse_args(argv)
 
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bigdl_tpu.utils.engine import Engine
+
+    Engine.init()  # honors BIGDL_TPU_PLATFORM, like the sibling benches
+
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -53,6 +53,39 @@ def test_indivisible_batch_raises():
         _train(5)  # 16 % 5 != 0
 
 
+def test_ragged_tail_falls_back_unaccumulated():
+    """An indivisible batch (a finite pipeline's ragged tail) computes
+    the same true mean gradient through one unaccumulated step instead
+    of crashing mid-run — and agrees with the accumulated result on a
+    divisible batch of the same data."""
+    import jax
+    import jax.numpy as jnp
+
+    from bigdl_tpu.optim.optimizer import accumulated_value_and_grad
+
+    model = nn.Sequential(nn.Linear(FEAT, 3), nn.LogSoftMax()).build(seed=9)
+    crit = nn.ClassNLLCriterion()
+
+    def loss_fn(params, buffers, data, labels, rng):
+        out, nb = model.apply(params, data, buffers=buffers,
+                              training=True, rng=rng)
+        return crit.loss(out, labels), nb
+
+    rng = jax.random.PRNGKey(0)
+    npr = np.random.RandomState(3)
+    x10 = jnp.asarray(npr.randn(10, FEAT).astype(np.float32))
+    y10 = jnp.asarray((npr.randint(0, 3, 10) + 1).astype(np.float32))
+    # 10 % 4 != 0: must fall back, not raise
+    (l_tail, _), g_tail = accumulated_value_and_grad(
+        loss_fn, 4, model.params, model.buffers, x10, y10, rng)
+    (l_ref, _), g_ref = accumulated_value_and_grad(
+        loss_fn, 1, model.params, model.buffers, x10, y10, rng)
+    assert float(l_tail) == float(l_ref)
+    for a, b in zip(jax.tree_util.tree_leaves(g_tail),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_setter_rejects_nonpositive():
     model = nn.Sequential(nn.Linear(FEAT, 3)).build(seed=1)
     opt = LocalOptimizer(model, _dataset(16), nn.MSECriterion())
