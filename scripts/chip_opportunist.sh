@@ -28,12 +28,19 @@ say() { echo "$(date +%H:%M:%S) $*" >> "$LOG"; }
 #   BIGDL_TPU_OPPORTUNIST_SMOKE=1 BIGDL_TPU_PLATFORM=cpu \
 #   BIGDL_TPU_BENCH_PLATFORM=cpu bash scripts/chip_opportunist.sh
 SMOKE="${BIGDL_TPU_OPPORTUNIST_SMOKE:-0}"
-if [ "$SMOKE" = "1" ] && [ "$(pwd -P)" = "/root/repo" ]; then
+if [ "$SMOKE" = "1" ]; then
   # the rehearsal writes CPU artifacts and FORCE_LASTs the replay
   # source — in the real repo that would clobber the round's one real
-  # TPU measurement and commit garbage scaling predictions
-  echo "refusing: smoke mode must run in a scratch clone, not /root/repo" >&2
-  exit 2
+  # TPU measurement and auto-commit garbage scaling predictions.
+  # Positive scratch-clone detection: a clone of the repo has an origin
+  # remote pointing back at it; the real repo IS the origin and has
+  # none (the path check is belt on top, in case someone adds a remote)
+  if ! git remote get-url origin >/dev/null 2>&1 \
+      || [ "$(pwd -P)" = "/root/repo" ]; then
+    echo "refusing: smoke mode must run in a scratch clone" \
+         "(git clone /root/repo /tmp/opp_smoke), not the real repo" >&2
+    exit 2
+  fi
 fi
 if [ "$SMOKE" = "1" ]; then
   BENCH_FLOOR=0.01           # CPU throughput is tiny but real
@@ -93,20 +100,28 @@ PROFILE_TPU.json TUNNEL_STRESS.json \
 SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
 
 commit_artifacts() {  # commit_artifacts <message>
-  local msg="$1" i f existing=""
+  local msg="$1" i f existing="" adds_ok
   for i in 1 2 3; do
     existing=""
+    adds_ok=1
     for f in $ARTIFACTS; do
-      [ -f "$f" ] && existing="$existing $f" \
-        && git add -- "$f" >> "$LOG" 2>&1
+      if [ -f "$f" ]; then
+        existing="$existing $f"
+        git add -- "$f" >> "$LOG" 2>&1 || adds_ok=0
+      fi
     done
-    if git diff --cached --quiet -- $ARTIFACTS 2>> "$LOG"; then
+    # the early-return is only trustworthy when every add succeeded —
+    # adds failing under a held index.lock also leave nothing staged,
+    # and returning "nothing to commit" there would defeat the retry
+    # loop this function exists for
+    if [ $adds_ok -eq 1 ] \
+        && git diff --cached --quiet -- $ARTIFACTS 2>> "$LOG"; then
       say "no new artifact content to commit"
       return 0
     fi
     # pathspec-limited: a concurrent interactive session's staged work
     # must never be swept into a measurement-artifacts commit
-    if git commit -q -m "$msg
+    if [ $adds_ok -eq 1 ] && git commit -q -m "$msg
 
 No-Verification-Needed: measurement artifacts only" -- $existing \
         >> "$LOG" 2>&1; then
@@ -115,6 +130,9 @@ No-Verification-Needed: measurement artifacts only" -- $existing \
     fi
     sleep 5
   done
+  # leave nothing staged: the next interactive plain `git commit` must
+  # not silently sweep artifact blobs under an unrelated message
+  [ -n "$existing" ] && git reset -q -- $existing >> "$LOG" 2>&1
   say "artifact commit failed (see log) - driver will pick them up"
 }
 
