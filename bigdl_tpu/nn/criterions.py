@@ -46,6 +46,14 @@ class ClassNLLCriterion(Criterion):
             return total / jnp.sum(w) if self.size_average else total
         return _reduce(-picked, self.size_average)
 
+    def _flat_time_reduction(self):
+        if self.weights is not None:
+            # weighted size_average normalizes by each call's own
+            # weight sum — flattening changes the normalizer; the
+            # weighted SUM has no normalizer and flattens exactly
+            return None if self.size_average else "sum"
+        return "mean" if self.size_average else "sum"
+
 
 class CrossEntropyCriterion(Criterion):
     """LogSoftMax + ClassNLL fused (ref nn/CrossEntropyCriterion.scala)."""
@@ -57,6 +65,9 @@ class CrossEntropyCriterion(Criterion):
     def loss(self, output, target):
         return self._nll.loss(jax.nn.log_softmax(output, axis=-1), target)
 
+    def _flat_time_reduction(self):
+        return self._nll._flat_time_reduction()  # softmax is per-row
+
 
 class MSECriterion(Criterion):
     def __init__(self, size_average: bool = True):
@@ -65,6 +76,11 @@ class MSECriterion(Criterion):
 
     def loss(self, output, target):
         return _reduce(jnp.square(output - target), self.size_average)
+
+    def _flat_time_reduction(self):
+        # mean/sum over ALL elements: equal per-timestep element counts
+        # make the flattened call value-identical
+        return "mean" if self.size_average else "sum"
 
 
 class AbsCriterion(Criterion):
@@ -416,9 +432,42 @@ class TimeDistributedCriterion(Criterion):
 
     def loss(self, output, target):
         t_steps = output.shape[1]
-        total = 0.0
-        for t in range(t_steps):
-            total = total + self.criterion.loss(output[:, t], target[:, t])
+        if t_steps == 0:
+            # the old per-timestep loop summed zero iterations; keep a
+            # defined zero instead of NaN (mean of empty) or a
+            # ZeroDivisionError (size_average)
+            return jnp.zeros((), jnp.float32)
+        red = self.criterion._flat_time_reduction()
+        if red is not None:
+            # one flattened call instead of T traced per-timestep calls:
+            # the unrolled loop costs O(T) trace time and HLO size — at
+            # the long-context T=16384 LM shapes that is the difference
+            # between compiling in seconds and burning the measurement
+            # window.  "mean" inner losses recover the per-timestep SUM
+            # as flat_mean * T (equal element counts per step).
+            flat_o = jnp.reshape(output, (-1,) + output.shape[2:])
+            flat_t = jnp.reshape(target, (-1,) + target.shape[2:])
+            flat = self.criterion.loss(flat_o, flat_t)
+            if red == "mean":
+                # mean+size_average IS the flat mean — no *T/T round trip
+                return flat if self.size_average else flat * t_steps
+            return flat / t_steps if self.size_average else flat
+        # generic criterion (weighted normalizers etc.): lax.scan over
+        # the time axis compiles the body ONCE; the python loop it
+        # replaces unrolled T copies into the trace.  Accumulate in f32
+        # for stability, return in the inner loss's own dtype (what both
+        # the old loop and the flat path produce).
+        o_t = jnp.moveaxis(output, 1, 0)
+        y_t = jnp.moveaxis(target, 1, 0)
+        out_dtype = jax.eval_shape(self.criterion.loss, o_t[0], y_t[0]).dtype
+
+        def body(carry, xt):
+            o, y = xt
+            return carry + self.criterion.loss(o, y).astype(jnp.float32), None
+
+        total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32),
+                                (o_t, y_t))
+        total = total.astype(out_dtype)
         return total / t_steps if self.size_average else total
 
 

@@ -345,6 +345,16 @@ class Criterion:
     def loss(self, output: Activity, target: Activity) -> jnp.ndarray:
         raise NotImplementedError
 
+    def _flat_time_reduction(self) -> Optional[str]:
+        """How this loss reduces a batch, IF flattening extra leading
+        structure into the batch dim is value-equivalent: "mean" /
+        "sum", or None when it is not (e.g. per-call weighted
+        normalization).  TimeDistributedCriterion uses this to evaluate
+        (B, T, ...) as one (B*T, ...) call instead of tracing T
+        per-timestep calls — at long context the unrolled trace is
+        O(T) compile time and HLO size."""
+        return None
+
     # functional aliases
     def apply(self, output: Activity, target: Activity) -> jnp.ndarray:
         return self.loss(output, target)

@@ -1,0 +1,103 @@
+"""TimeDistributedCriterion's flattened fast path and scan fallback.
+
+The python per-timestep loop it replaces unrolled T criterion calls
+into the trace — O(T) compile time and HLO size, infeasible at the
+long-context LM shapes (T=16384) the staged measurements use.  These
+tests pin value equivalence against the explicit loop for every flag
+combination, including the weighted case that must take the scan
+fallback (its per-call normalizer is not flatten-invariant).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+
+B, T, C = 4, 6, 5
+
+
+def _loop_reference(crit, outer_avg, out, tgt):
+    total = 0.0
+    for t in range(out.shape[1]):
+        total = total + float(crit.loss(out[:, t], tgt[:, t]))
+    return total / out.shape[1] if outer_avg else total
+
+
+def _data(seed=0):
+    rng = np.random.RandomState(seed)
+    logp = np.log(rng.dirichlet(np.ones(C), size=(B, T)).astype(np.float32))
+    labels = (rng.randint(0, C, size=(B, T)) + 1).astype(np.float32)
+    return jnp.asarray(logp), jnp.asarray(labels)
+
+
+@pytest.mark.parametrize("inner_avg", [True, False])
+@pytest.mark.parametrize("outer_avg", [True, False])
+def test_classnll_flat_path_matches_loop(inner_avg, outer_avg):
+    out, tgt = _data()
+    inner = nn.ClassNLLCriterion(size_average=inner_avg)
+    assert inner._flat_time_reduction() == ("mean" if inner_avg else "sum")
+    td = nn.TimeDistributedCriterion(inner, outer_avg)
+    got = float(td.loss(out, tgt))
+    want = _loop_reference(inner, outer_avg, out, tgt)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("outer_avg", [True, False])
+def test_weighted_classnll_takes_scan_fallback(outer_avg):
+    out, tgt = _data(1)
+    w = np.linspace(0.5, 2.0, C).astype(np.float32)
+    inner = nn.ClassNLLCriterion(weights=w)  # size_average: per-call norm
+    assert inner._flat_time_reduction() is None
+    td = nn.TimeDistributedCriterion(inner, outer_avg)
+    got = float(td.loss(out, tgt))
+    want = _loop_reference(inner, outer_avg, out, tgt)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_weighted_sum_classnll_flattens():
+    """The weighted SUM has no per-call normalizer, so it flattens."""
+    out, tgt = _data(4)
+    w = np.linspace(0.5, 2.0, C).astype(np.float32)
+    inner = nn.ClassNLLCriterion(weights=w, size_average=False)
+    assert inner._flat_time_reduction() == "sum"
+    td = nn.TimeDistributedCriterion(inner, True)
+    np.testing.assert_allclose(float(td.loss(out, tgt)),
+                               _loop_reference(inner, True, out, tgt),
+                               rtol=1e-5)
+
+
+def test_empty_time_axis_is_zero():
+    td = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
+    assert float(td.loss(jnp.zeros((B, 0, C)), jnp.ones((B, 0)))) == 0.0
+
+
+@pytest.mark.parametrize("inner_avg", [True, False])
+def test_mse_flat_path_matches_loop(inner_avg):
+    rng = np.random.RandomState(2)
+    out = jnp.asarray(rng.randn(B, T, 3).astype(np.float32))
+    tgt = jnp.asarray(rng.randn(B, T, 3).astype(np.float32))
+    inner = nn.MSECriterion(size_average=inner_avg)
+    td = nn.TimeDistributedCriterion(inner, True)
+    got = float(td.loss(out, tgt))
+    want = _loop_reference(inner, True, out, tgt)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_long_context_traces_in_constant_size():
+    """The whole point: tracing at T=4096 must not unroll 4096 calls.
+    The jaxpr equation count must be small and T-independent."""
+    import jax
+
+    inner = nn.ClassNLLCriterion()
+    td = nn.TimeDistributedCriterion(inner, True)
+
+    def f(out, tgt):
+        return td.loss(out, tgt)
+
+    small = jax.make_jaxpr(f)(
+        jnp.zeros((1, 64, C)), jnp.ones((1, 64)))
+    large = jax.make_jaxpr(f)(
+        jnp.zeros((1, 4096, C)), jnp.ones((1, 4096)))
+    assert len(large.jaxpr.eqns) == len(small.jaxpr.eqns)
+    assert len(large.jaxpr.eqns) < 40
