@@ -58,6 +58,11 @@ class TransformerLM(Module):
     LookupTable parity).  Output: (B, T, vocab) log-probabilities.
     """
 
+    # class-level default: checkpoint restore builds instances via
+    # __new__ + saved __dict__ (file_io.build_module), so a model saved
+    # before this attribute existed must still forward cleanly
+    doc_start_id: Optional[int] = None
+
     def __init__(self, vocab_size: int, hidden_size: int = 128,
                  n_head: int = 4, n_layers: int = 2,
                  ffn_size: Optional[int] = None, max_len: int = 512,
@@ -68,7 +73,8 @@ class TransformerLM(Module):
                  rope_base: float = 10000.0,
                  moe_experts: int = 0,
                  moe_capacity_factor: Optional[float] = 1.25,
-                 moe_aux_weight: float = 0.01):
+                 moe_aux_weight: float = 0.01,
+                 doc_start_id: Optional[int] = None):
         super().__init__()
         assert hidden_size % n_head == 0
         if pos_encoding not in ("learned", "rope"):
@@ -100,6 +106,15 @@ class TransformerLM(Module):
         # then averages f_e/P_e globally (DistriOptimizer sets this
         # automatically; see expert._balance_loss for why it matters)
         self.moe_balance_axis: Optional[str] = None
+        # packed-document isolation: when set (1-based vocab id of the
+        # document-start marker, e.g. the Dictionary index of
+        # text.SENTENCE_START + 1), segment ids are derived from the
+        # input ids themselves (cumsum of marker positions) and
+        # attention is masked across document boundaries — inside the
+        # flash tiles on TPU, via an explicit mask on the XLA path.  No
+        # pipeline plumbing: DocumentPacker windows already carry the
+        # markers.  Positions stay window-absolute (standard packing).
+        self.doc_start_id = doc_start_id
         # attention plumbing (projections + kernel choice) is shared with
         # the standalone nn.MultiHeadAttention so there is one hot path
         self._mha = nn.MultiHeadAttention(
@@ -173,7 +188,8 @@ class TransformerLM(Module):
         return (apply_rope(q, positions, self.rope_base),
                 apply_rope(k, positions, self.rope_base))
 
-    def _block(self, bp, x, training: bool, rng, positions=None):
+    def _block(self, bp, x, training: bool, rng, positions=None,
+               segment_ids=None):
         mha = self._mha
         a = self._layer_norm(bp["ln1"], x)
         q, k, v = mha.project_qkv(bp["attn"], a, a, a)
@@ -182,10 +198,16 @@ class TransformerLM(Module):
         if mha.resolve_use_flash(q.shape[-2]):
             from bigdl_tpu.ops import flash_attention
             bs = mha.block_size or 128
-            o = flash_attention(q, k, v, causal=True, block_q=bs, block_k=bs)
+            o = flash_attention(q, k, v, causal=True,
+                                segment_ids=segment_ids,
+                                block_q=bs, block_k=bs)
         else:
             from bigdl_tpu.nn.attention import dot_product_attention
-            o = dot_product_attention(q, k, v, causal=True)
+            mask = None
+            if segment_ids is not None:
+                mask = (segment_ids[:, None, :, None]
+                        == segment_ids[:, None, None, :])
+            o = dot_product_attention(q, k, v, causal=True, mask=mask)
         o = mha.project_out(bp["attn"], o)
         if training and self.dropout > 0.0:
             rng, sub = jax.random.split(rng)
@@ -218,12 +240,18 @@ class TransformerLM(Module):
                     "dropout mask every step")
             rng = jax.random.PRNGKey(0)
 
+        segment_ids = None
+        if self.doc_start_id is not None:
+            # ids are already 0-based here; the marker id came in 1-based
+            segment_ids = jnp.cumsum(
+                (ids == self.doc_start_id - 1).astype(jnp.int32), axis=-1)
+
         block = (jax.checkpoint(self._block, static_argnums=(2,))
                  if self.remat else self._block)
         keys = jax.random.split(rng, self.n_layers)
         h, auxes = jax.lax.scan(
             lambda carry, layer: block(layer[0], carry, training, layer[1],
-                                       positions),
+                                       positions, segment_ids),
             h, (params["blocks"], keys))
         h = self._layer_norm(params["ln_f"], h)
         head = (params["embed"].T.astype(h.dtype) if self.tie_embeddings

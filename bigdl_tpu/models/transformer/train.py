@@ -45,6 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--packed", action="store_true",
                    help="pack documents into dense fixed-length windows "
                         "instead of padding each sentence")
+    p.add_argument("--docIsolate", action="store_true",
+                   help="with --packed: mask attention across document "
+                        "boundaries (segment ids derived from the "
+                        "sentence-start markers; flash tiles stay flash)")
     p.add_argument("--distributed", action="store_true")
     p.add_argument("--synthetic", action="store_true")
     return p
@@ -92,12 +96,28 @@ def main(argv=None) -> None:
             "continuing WITHOUT validation", e)
         val_ds = None
 
-    model = nn.Module.load(args.model) if args.model else \
-        TransformerLM(vocab, hidden_size=args.hiddenSize, n_head=args.nHead,
-                      n_layers=args.nLayers, max_len=args.seqLength,
-                      dropout=args.dropout, remat=args.remat,
-                      pos_encoding=args.posEncoding,
-                      moe_experts=args.moeExperts).build(seed=1)
+    doc_start_id = None
+    if args.docIsolate:
+        if not args.packed:
+            raise SystemExit("--docIsolate requires --packed (the padded "
+                             "pipeline never mixes documents in a window)")
+        from bigdl_tpu.dataset.text import SENTENCE_START
+        doc_start_id = dictionary.get_index(SENTENCE_START) + 1  # 1-based
+    if args.model:
+        model = nn.Module.load(args.model)
+        if args.docIsolate:
+            # a resumed/fine-tuned model honors the flag too — silently
+            # keeping whatever the checkpoint was saved with would train
+            # with cross-document attention after the user asked not to
+            model.doc_start_id = doc_start_id
+    else:
+        model = TransformerLM(
+            vocab, hidden_size=args.hiddenSize, n_head=args.nHead,
+            n_layers=args.nLayers, max_len=args.seqLength,
+            dropout=args.dropout, remat=args.remat,
+            pos_encoding=args.posEncoding,
+            moe_experts=args.moeExperts,
+            doc_start_id=doc_start_id).build(seed=1)
     criterion = nn.TimeDistributedCriterion(nn.ClassNLLCriterion(), True)
     method = {"sgd": SGD, "adam": Adam, "adamw": AdamW}[args.optim](
         learning_rate=args.learningRate, weight_decay=args.weightDecay)

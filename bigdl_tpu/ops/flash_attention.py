@@ -36,10 +36,14 @@ _NEG = -1e30  # large-negative mask value: avoids (-inf) - (-inf) NaNs
 _LANES = 128  # m/l scratch is kept lane-replicated for TPU-friendly tiles
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                acc_ref, m_ref, l_ref, *,
-                scale: float, causal: bool, tq_real: int, tk_real: int,
-                block_q: int, block_k: int):
+def _fwd_kernel(*refs, scale: float, causal: bool, segmented: bool,
+                tq_real: int, tk_real: int, block_q: int, block_k: int):
+    if segmented:
+        (q_ref, k_ref, v_ref, sq_ref, sk_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
+        sq_ref = sk_ref = None
     iq = pl.program_id(2)
     j = pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -74,6 +78,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
             q_pos = iq * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0) + (tk_real - tq_real)
             mask = jnp.logical_and(mask, q_pos >= k_pos)
+        if segmented:
+            # packed-document isolation: a query attends only within its
+            # own segment (pad fills -1/-2 can never match)
+            mask = jnp.logical_and(
+                mask, sq_ref[0][:, None] == sk_ref[0][None, :])
         s = jnp.where(mask, s, _NEG)
         m_prev = m_ref[:, :1]
         l_prev = l_ref[:, :1]
@@ -117,11 +126,23 @@ def _pad_t(x, block):
     return jnp.pad(x, [(0, 0), (0, 0), (0, block - rem), (0, 0)])
 
 
+def _pad_seg(seg, block, fill):
+    """Pad (B, T) segment ids to a block multiple with a fill that can
+    never equal a real id on the other side (-1 vs -2)."""
+    t = seg.shape[1]
+    rem = t % block
+    if rem == 0:
+        return seg
+    return jnp.pad(seg, [(0, 0), (0, block - rem)], constant_values=fill)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "causal", "scale", "block_q", "block_k", "interpret"))
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, seg_q, seg_k, causal, scale, block_q, block_k,
+               interpret):
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    segmented = seg_q is not None
     qp = _pad_t(q, block_q)
     kp = _pad_t(k, block_k)
     vp = _pad_t(v, block_k)
@@ -129,19 +150,28 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
     n_q, n_k = tq_pad // block_q, tk_pad // block_k
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, tq_real=tq, tk_real=tk,
-        block_q=block_q, block_k=block_k)
+        _fwd_kernel, scale=scale, causal=causal, segmented=segmented,
+        tq_real=tq, tk_real=tk, block_q=block_q, block_k=block_k)
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, d),
+                     lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, d),
+                     lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+    ]
+    operands = [qp, kp, vp]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda bi, hi, qi, ki: (bi, qi)),
+            pl.BlockSpec((1, block_k), lambda bi, hi, qi, ki: (bi, ki)),
+        ]
+        operands += [_pad_seg(seg_q.astype(jnp.int32), block_q, -1),
+                     _pad_seg(seg_k.astype(jnp.int32), block_k, -2)]
     o, lse = pl.pallas_call(
         kernel,
         grid=(b, h, n_q, n_k),  # j innermost: scratch accumulates over it
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
@@ -158,17 +188,23 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running sum
         ],
         interpret=interpret,
-    )(qp, kp, vp)
+    )(*operands)
     return o[:, :, :tq], lse[:, :, :tq]
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dlse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    scale: float, causal: bool, tq_real: int, tk_real: int,
+def _bwd_dkv_kernel(*refs, scale: float, causal: bool, segmented: bool,
+                    tq_real: int, tk_real: int,
                     block_q: int, block_k: int):
     """Grid (B, H, n_k, n_q), query blocks innermost: one (block_k, d)
     dk/dv pair accumulates in VMEM scratch while (block_q, d) q/do tiles
     stream past — the mirror image of the forward's streaming direction."""
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+         sq_ref, sk_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        sq_ref = sk_ref = None
     ik = pl.program_id(2)
     iq = pl.program_id(3)
     n_q = pl.num_programs(3)
@@ -202,6 +238,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = jnp.logical_and(q_pos < tq_real, k_pos < tk_real)
         if causal:
             mask = jnp.logical_and(mask, q_pos + (tk_real - tq_real) >= k_pos)
+        if segmented:
+            mask = jnp.logical_and(
+                mask, sq_ref[0][:, None] == sk_ref[0][None, :])
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv_acc[:] += jnp.dot(p.T.astype(do.dtype), do,
                              preferred_element_type=jnp.float32)
@@ -216,13 +255,19 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   dlse_ref, dq_ref, dq_acc, *,
-                   scale: float, causal: bool, tq_real: int, tk_real: int,
+def _bwd_dq_kernel(*refs, scale: float, causal: bool, segmented: bool,
+                   tq_real: int, tk_real: int,
                    block_q: int, block_k: int):
     """Grid (B, H, n_q, n_k), key blocks innermost: dq for one query block
     accumulates in scratch while K/V tiles stream past (same streaming
     direction as the forward)."""
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+         sq_ref, sk_ref, dq_ref, dq_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dlse_ref,
+         dq_ref, dq_acc) = refs
+        sq_ref = sk_ref = None
     iq = pl.program_id(2)
     j = pl.program_id(3)
     n_k = pl.num_programs(3)
@@ -254,6 +299,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = jnp.logical_and(q_pos < tq_real, k_pos < tk_real)
         if causal:
             mask = jnp.logical_and(mask, q_pos + (tk_real - tq_real) >= k_pos)
+        if segmented:
+            mask = jnp.logical_and(
+                mask, sq_ref[0][:, None] == sk_ref[0][None, :])
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jnp.dot(do, vb.T, preferred_element_type=jnp.float32)
         ds = p * (dp - rest)
@@ -275,13 +323,14 @@ def _pad1_t(x, block):
 
 @functools.partial(jax.jit, static_argnames=(
     "causal", "scale", "block_q", "block_k", "interpret"))
-def _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k,
-               interpret):
+def _flash_bwd(q, k, v, o, lse, do, dlse, seg_q, seg_k, causal, scale,
+               block_q, block_k, interpret):
     """Tiled backward: dq, dk, dv with nothing of size (Tq, Tk) resident.
     ``delta = rowsum(do * o)`` is the standard flash backward scalar; the
     optional lse cotangent folds in as ``ds += p * dlse``."""
     b, h, tq, d = q.shape
     tk = k.shape[2]
+    segmented = seg_q is not None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     qp, dop = _pad_t(q, block_q), _pad_t(do, block_q)
     kp, vp = _pad_t(k, block_k), _pad_t(v, block_k)
@@ -290,6 +339,9 @@ def _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k,
     dlsep = _pad1_t(dlse.astype(jnp.float32), block_q)
     tq_pad, tk_pad = qp.shape[2], kp.shape[2]
     n_q, n_k = tq_pad // block_q, tk_pad // block_k
+    if segmented:
+        sqp = _pad_seg(seg_q.astype(jnp.int32), block_q, -1)
+        skp = _pad_seg(seg_k.astype(jnp.int32), block_k, -2)
 
     qspec = pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, oi, ii: (bi, hi, ii, 0))
@@ -298,12 +350,20 @@ def _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k,
     rowspec = pl.BlockSpec((1, 1, block_q),
                            lambda bi, hi, oi, ii: (bi, hi, ii))
     dkv_kernel = functools.partial(
-        _bwd_dkv_kernel, scale=scale, causal=causal, tq_real=tq, tk_real=tk,
-        block_q=block_q, block_k=block_k)
+        _bwd_dkv_kernel, scale=scale, causal=causal, segmented=segmented,
+        tq_real=tq, tk_real=tk, block_q=block_q, block_k=block_k)
+    in_specs = [qspec, kspec_o, kspec_o, qspec, rowspec, rowspec, rowspec]
+    operands = [qp, kp, vp, dop, lsep, deltap, dlsep]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda bi, hi, oi, ii: (bi, ii)),
+            pl.BlockSpec((1, block_k), lambda bi, hi, oi, ii: (bi, oi)),
+        ]
+        operands += [sqp, skp]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(b, h, n_k, n_q),  # query blocks innermost
-        in_specs=[qspec, kspec_o, kspec_o, qspec, rowspec, rowspec, rowspec],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, oi, ii: (bi, hi, oi, 0)),
@@ -319,7 +379,7 @@ def _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap, dlsep)
+    )(*operands)
 
     qspec2 = pl.BlockSpec((1, 1, block_q, d),
                           lambda bi, hi, oi, ii: (bi, hi, oi, 0))
@@ -328,13 +388,21 @@ def _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k,
     rowspec2 = pl.BlockSpec((1, 1, block_q),
                             lambda bi, hi, oi, ii: (bi, hi, oi))
     dq_kernel = functools.partial(
-        _bwd_dq_kernel, scale=scale, causal=causal, tq_real=tq, tk_real=tk,
-        block_q=block_q, block_k=block_k)
+        _bwd_dq_kernel, scale=scale, causal=causal, segmented=segmented,
+        tq_real=tq, tk_real=tk, block_q=block_q, block_k=block_k)
+    in_specs2 = [qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2,
+                 rowspec2]
+    operands2 = [qp, kp, vp, dop, lsep, deltap, dlsep]
+    if segmented:
+        in_specs2 += [
+            pl.BlockSpec((1, block_q), lambda bi, hi, oi, ii: (bi, oi)),
+            pl.BlockSpec((1, block_k), lambda bi, hi, oi, ii: (bi, ii)),
+        ]
+        operands2 += [sqp, skp]
     (dq,) = pl.pallas_call(
         dq_kernel,
         grid=(b, h, n_q, n_k),  # key blocks innermost
-        in_specs=[qspec2, kspec2, kspec2, qspec2, rowspec2, rowspec2,
-                  rowspec2],
+        in_specs=in_specs2,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, oi, ii: (bi, hi, oi, 0)),
@@ -342,7 +410,7 @@ def _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale, block_q, block_k,
         out_shape=[_sds((b, h, tq_pad, d), q.dtype, q, k, v, do)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap, dlsep)
+    )(*operands2)
     return dq[:, :, :tq], dk[:, :, :tk], dv[:, :, :tk]
 
 
@@ -350,17 +418,17 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                      _use_interpret())
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, seg_q, seg_k, causal, scale, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, seg_q, seg_k, causal, scale, block_q,
+                      block_k, _use_interpret())
     return o
 
 
-def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                        _use_interpret())
-    return o, (q, k, v, o, lse)
+def _flash_vjp_fwd(q, k, v, seg_q, seg_k, causal, scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, seg_q, seg_k, causal, scale, block_q,
+                        block_k, _use_interpret())
+    return o, (q, k, v, seg_q, seg_k, o, lse)
 
 
 def _flash_bwd_reference(causal, scale, res, do, dlse=None):
@@ -393,32 +461,37 @@ def _flash_bwd_reference(causal, scale, res, do, dlse=None):
 
 
 def _flash_vjp_bwd(causal, scale, block_q, block_k, res, do):
-    q, k, v, o, lse = res
+    q, k, v, seg_q, seg_k, o, lse = res
     dlse = jnp.zeros(lse.shape, jnp.float32)
-    return _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale,
-                      block_q, block_k, _use_interpret())
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, dlse, seg_q, seg_k,
+                            causal, scale, block_q, block_k,
+                            _use_interpret())
+    return dq, dk, dv, None, None  # int segment ids carry no cotangent
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_lse(q, k, v, causal, scale, block_q, block_k):
-    return _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                      _use_interpret())
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_lse(q, k, v, seg_q, seg_k, causal, scale, block_q, block_k):
+    return _flash_fwd(q, k, v, seg_q, seg_k, causal, scale, block_q,
+                      block_k, _use_interpret())
 
 
-def _flash_lse_vjp_fwd(q, k, v, causal, scale, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k,
-                        _use_interpret())
-    return (o, lse), (q, k, v, o, lse)
+def _flash_lse_vjp_fwd(q, k, v, seg_q, seg_k, causal, scale, block_q,
+                       block_k):
+    o, lse = _flash_fwd(q, k, v, seg_q, seg_k, causal, scale, block_q,
+                        block_k, _use_interpret())
+    return (o, lse), (q, k, v, seg_q, seg_k, o, lse)
 
 
 def _flash_lse_vjp_bwd(causal, scale, block_q, block_k, res, cts):
     do, dlse = cts
-    q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, dlse, causal, scale,
-                      block_q, block_k, _use_interpret())
+    q, k, v, seg_q, seg_k, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, dlse, seg_q, seg_k,
+                            causal, scale, block_q, block_k,
+                            _use_interpret())
+    return dq, dk, dv, None, None
 
 
 _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
@@ -443,14 +516,24 @@ def use_flash_auto(seq_len: int) -> bool:
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
+                    segment_ids=None,
                     block_q: int = 128, block_k: int = 128):
     """Tiled flash attention.  q: (B, H, Tq, D); k, v: (B, H, Tk, D) — D
     should be a multiple of 128 for MXU-aligned tiles (smaller D works at
     reduced efficiency).  Runs the Pallas kernel on TPU, interpreter mode
-    elsewhere; differentiable via the recomputation backward."""
+    elsewhere; differentiable via the recomputation backward.
+
+    ``segment_ids`` (B, T) int: packed-document isolation for
+    self-attention — position i attends position j only when their ids
+    match (on top of causality), so documents packed into one window
+    (dataset.text.DocumentPacker) never attend across boundaries.  The
+    mask is applied inside the existing tiles: no (T, T) materialization,
+    same VMEM footprint.  Self-attention only (requires Tq == Tk)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash(q, k, v, causal, float(scale),
+    if segment_ids is not None and q.shape[-2] != k.shape[-2]:
+        raise ValueError("segment_ids requires self-attention (Tq == Tk)")
+    return _flash(q, k, v, segment_ids, segment_ids, causal, float(scale),
                   int(block_q), int(block_k))
 
 
@@ -471,5 +554,5 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
     cotangent as ``p * dlse`` (d lse/d s = softmax)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_lse(q, k, v, causal, float(scale), int(block_q),
-                      int(block_k))
+    return _flash_lse(q, k, v, None, None, causal, float(scale),
+                      int(block_q), int(block_k))

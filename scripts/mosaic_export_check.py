@@ -12,7 +12,7 @@ BENCH_ATTN.json / BENCH_LM.json (scripts/tpu_round4_runs.sh).
 
 Programs are registered as thunks: ``--only <substr>`` runs only the
 matching ones (nothing else is even built) and writes to a scratch
-path so the committed 9-program artifact can't be clobbered by an
+path so the committed full artifact can't be clobbered by an
 iteration run.
 """
 from __future__ import annotations
@@ -34,7 +34,7 @@ def main(argv=None) -> None:
                         "must be regenerated unfiltered)")
     args = p.parse_args(argv)
     if args.only and args.json == "MOSAIC_EXPORT.json":
-        # never let an iteration run clobber the committed 9-program
+        # never let an iteration run clobber the committed full
         # artifact with a filtered subset
         args.json = "/tmp/MOSAIC_EXPORT_partial.json"
         print(f"--only set: writing filtered results to {args.json}",
@@ -85,6 +85,16 @@ def main(argv=None) -> None:
             lambda q, k, v: jax.grad(
                 lambda a, b, c: flash_attention(a, b, c, causal=True)
                 .astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v), qkv)
+        # packed-document isolation: the segment-masked tiles must lower
+        # through Mosaic too (fwd + both backward kernels)
+        seg = jax.ShapeDtypeStruct((1, 4096), jnp.int32)
+        run_export(
+            "flash_train_segmented_T4096",
+            lambda q, k, v, s: jax.grad(
+                lambda a, b, c: flash_attention(
+                    a, b, c, causal=True, segment_ids=s)
+                .astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v),
+            qkv + [seg])
 
     def prog_lm():
         model = TransformerLM(vocab_size=32000, hidden_size=512, n_head=8,

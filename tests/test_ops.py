@@ -161,12 +161,12 @@ class TestPallasBackwardKernel:
                                                    _flash_bwd_reference,
                                                    _flash_fwd)
         q, k, v = _qkv(t=50, seed=20)
-        o, lse = _flash_fwd(q, k, v, True, 0.25, 16, 16, True)
+        o, lse = _flash_fwd(q, k, v, None, None, True, 0.25, 16, 16, True)
         do = jnp.asarray(np.random.RandomState(21).randn(*o.shape),
                          jnp.float32)
         dlse = jnp.asarray(np.random.RandomState(22).randn(*lse.shape),
                            jnp.float32)
-        got = _flash_bwd(q, k, v, o, lse, do, dlse, True, 0.25, 16, 16, True)
+        got = _flash_bwd(q, k, v, o, lse, do, dlse, None, None, True, 0.25, 16, 16, True)
         want = _flash_bwd_reference(True, 0.25, (q, k, v, o, lse), do, dlse)
         for a, b in zip(got, want):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -180,10 +180,10 @@ class TestPallasBackwardKernel:
         q = jnp.asarray(rng.randn(1, 2, 24, 16).astype(np.float32))
         k = jnp.asarray(rng.randn(1, 2, 40, 16).astype(np.float32))
         v = jnp.asarray(rng.randn(1, 2, 40, 16).astype(np.float32))
-        o, lse = _flash_fwd(q, k, v, True, 0.25, 16, 16, True)
+        o, lse = _flash_fwd(q, k, v, None, None, True, 0.25, 16, 16, True)
         do = jnp.asarray(rng.randn(*o.shape), jnp.float32)
         dlse = jnp.zeros(lse.shape, jnp.float32)
-        got = _flash_bwd(q, k, v, o, lse, do, dlse, True, 0.25, 16, 16, True)
+        got = _flash_bwd(q, k, v, o, lse, do, dlse, None, None, True, 0.25, 16, 16, True)
         want = _flash_bwd_reference(True, 0.25, (q, k, v, o, lse), do)
         for a, b in zip(got, want):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -281,3 +281,83 @@ def test_flash_kernels_lower_for_tpu_platform():
         jax.tree_util.tree_map(sds, params),
         jax.tree_util.tree_map(sds, opt_state), xs, xs)
     assert lm.platforms == ("tpu",)
+
+
+class TestSegmentedFlash:
+    """Packed-document isolation: segment_ids mask attention across
+    document boundaries inside the flash tiles.  Oracle: the plain XLA
+    attention with the equivalent explicit (B, 1, Tq, Tk) mask."""
+
+    @staticmethod
+    def _segs(b, t, n_docs, seed):
+        rng = np.random.RandomState(seed)
+        # random document boundaries -> non-decreasing segment ids
+        cuts = np.sort(rng.choice(np.arange(1, t), size=n_docs - 1,
+                                  replace=False))
+        seg = np.zeros((b, t), np.int32)
+        for c in cuts:
+            seg[:, c:] += 1
+        # vary across batch: roll each row by a different offset's worth
+        # of documents
+        for i in range(1, b):
+            seg[i] = (seg[i] + i) % n_docs
+        return jnp.asarray(seg)
+
+    @staticmethod
+    def _mask(seg):
+        return (seg[:, None, :, None] == seg[:, None, None, :])
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_masked_reference(self, causal):
+        q, k, v = _qkv(t=64, seed=30)
+        seg = self._segs(2, 64, 4, 31)
+        out = flash_attention(q, k, v, causal=causal, segment_ids=seg,
+                              block_q=16, block_k=16)
+        ref = dot_product_attention(q, k, v, causal=causal,
+                                    mask=self._mask(seg))
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_unaligned_t(self):
+        """T not a block multiple: the -1/-2 segment pad fills must
+        never match each other or any real id."""
+        q, k, v = _qkv(t=53, seed=32)
+        seg = self._segs(2, 53, 3, 33)
+        out = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                              block_q=16, block_k=16)
+        ref = dot_product_attention(q, k, v, causal=True,
+                                    mask=self._mask(seg))
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_grads_match_masked_reference(self):
+        q, k, v = _qkv(t=48, seed=34)
+        seg = self._segs(2, 48, 3, 35)
+
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                                block_q=16, block_k=16)
+            return jnp.sum((o - 1.0) ** 2)  # nonzero do everywhere
+
+        def loss_ref(q, k, v):
+            o = dot_product_attention(q, k, v, causal=True,
+                                      mask=self._mask(seg))
+            return jnp.sum((o - 1.0) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+    def test_single_segment_is_vanilla(self):
+        """All-one-segment ids must reproduce unsegmented attention."""
+        q, k, v = _qkv(t=32, seed=36)
+        seg = jnp.zeros((2, 32), jnp.int32)
+        out = flash_attention(q, k, v, causal=True, segment_ids=seg,
+                              block_q=16, block_k=16)
+        ref = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_rejected(self):
+        q, k, v = _qkv(t=32, seed=37)
+        with pytest.raises(ValueError, match="self-attention"):
+            flash_attention(q, k[:, :, :16], v[:, :, :16],
+                            segment_ids=jnp.zeros((2, 32), jnp.int32))
