@@ -205,8 +205,8 @@ class TransformerLM(Module):
             from bigdl_tpu.nn.attention import dot_product_attention
             mask = None
             if segment_ids is not None:
-                mask = (segment_ids[:, None, :, None]
-                        == segment_ids[:, None, None, :])
+                from bigdl_tpu.nn.attention import segment_mask
+                mask = segment_mask(segment_ids, segment_ids)
             o = dot_product_attention(q, k, v, causal=True, mask=mask)
         o = mha.project_out(bp["attn"], o)
         if training and self.dropout > 0.0:

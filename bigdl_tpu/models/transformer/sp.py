@@ -56,9 +56,10 @@ def ring_lm_apply(model: TransformerLM, params, ids, mesh: Mesh, *,
     if block_size is None:
         block_size = mha.block_size or 128
 
-    def attn(q, k, v):
+    def attn(q, k, v, seg=None):
         return ring_attention_local(q, k, v, seq_axis, causal=True,
-                                    impl=impl, block_size=block_size)
+                                    impl=impl, block_size=block_size,
+                                    segment_ids=seg)
 
     return _sequence_parallel_apply(model, params, ids, mesh,
                                     seq_axis=seq_axis, data_axis=data_axis,
@@ -82,8 +83,9 @@ def ulysses_lm_apply(model: TransformerLM, params, ids, mesh: Mesh, *,
             f"'{seq_axis}' axis size ({axis_size}); use ring_lm_apply "
             f"otherwise")
 
-    def attn(q, k, v):
-        return ulysses_attention_local(q, k, v, seq_axis, causal=True)
+    def attn(q, k, v, seg=None):
+        return ulysses_attention_local(q, k, v, seq_axis, causal=True,
+                                       segment_ids=seg)
 
     return _sequence_parallel_apply(model, params, ids, mesh,
                                     seq_axis=seq_axis, data_axis=data_axis,
@@ -131,11 +133,26 @@ def _sequence_parallel_apply(model, params, ids, mesh, *, seq_axis,
             h = h + lax.dynamic_slice(params["pos"], (offset, 0),
                                       (t_local, params["pos"].shape[1]))
 
+        seg_local = None
+        if model.doc_start_id is not None:
+            # GLOBAL segment ids from local shards: each shard's cumsum
+            # plus the marker total of every shard before it on the axis
+            # (one (N, B)-int all_gather — noise next to the k/v traffic)
+            marker = (ids_i == model.doc_start_id - 1).astype(jnp.int32)
+            local_cum = jnp.cumsum(marker, axis=-1)
+            totals = lax.all_gather(local_cum[..., -1], seq_axis)  # (N, B)
+            n_sh = totals.shape[0]
+            my = lax.axis_index(seq_axis)
+            prev = jnp.sum(
+                jnp.where(jnp.arange(n_sh)[:, None] < my, totals, 0),
+                axis=0)  # (B,)
+            seg_local = local_cum + prev[:, None]
+
         def block(bp, h):
             a = model._layer_norm(bp["ln1"], h)
             q, k, v = mha.project_qkv(bp["attn"], a, a, a)
             q, k = model._rope(q, k, positions)
-            o = attn_fn(q, k, v)
+            o = attn_fn(q, k, v, seg_local)
             h = h + mha.project_out(bp["attn"], o)
             m = model._layer_norm(bp["ln2"], h)
             m, _ = model._mlp(bp, m)

@@ -42,6 +42,13 @@ def online_softmax_update(carry, block):
     return o, l, m_new
 
 
+def segment_mask(seg_q, seg_k):
+    """(B, 1, Tq, Tk) boolean packed-document isolation mask from
+    (B, Tq)/(B, Tk) segment ids — broadcasts over the head dim; the one
+    definition of the layout every attention path shares."""
+    return seg_q[:, None, :, None] == seg_k[:, None, None, :]
+
+
 def _block_scores(q, k, v, mask, scale):
     """Partial attention of q against one k/v block.
     q: (..., Tq, D); k, v: (..., Tk, D); mask: broadcastable (..., Tq, Tk)

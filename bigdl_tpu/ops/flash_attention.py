@@ -539,6 +539,7 @@ def flash_attention(q, k, v, *, causal: bool = False,
 
 def flash_attention_with_lse(q, k, v, *, causal: bool = False,
                              scale: Optional[float] = None,
+                             q_segment_ids=None, kv_segment_ids=None,
                              block_q: int = 128, block_k: int = 128):
     """Flash attention that also returns the logsumexp (B, H, Tq) of the
     scaled scores.  Two partial results over disjoint key sets merge
@@ -551,8 +552,16 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = False,
     into ring attention (each ring hop contributes one (o, lse) pair).
     Fully-masked rows report lse ~ -1e30 and o = 0, the identity of that
     merge.  Differentiable: the lse cotangent folds into the score
-    cotangent as ``p * dlse`` (d lse/d s = softmax)."""
+    cotangent as ``p * dlse`` (d lse/d s = softmax).
+
+    ``q_segment_ids`` (B, Tq) / ``kv_segment_ids`` (B, Tk): packed-
+    document isolation with INDEPENDENT sides — exactly what ring
+    attention needs, where the rotating k/v shard carries a different
+    slice of the global segment ids than the local queries."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_lse(q, k, v, None, None, causal, float(scale),
-                      int(block_q), int(block_k))
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        raise ValueError("pass both q_segment_ids and kv_segment_ids "
+                         "or neither")
+    return _flash_lse(q, k, v, q_segment_ids, kv_segment_ids, causal,
+                      float(scale), int(block_q), int(block_k))
