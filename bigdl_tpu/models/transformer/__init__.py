@@ -195,19 +195,10 @@ class TransformerLM(Module):
         q, k, v = mha.project_qkv(bp["attn"], a, a, a)
         if positions is not None:
             q, k = self._rope(q, k, positions)
-        if mha.resolve_use_flash(q.shape[-2]):
-            from bigdl_tpu.ops import flash_attention
-            bs = mha.block_size or 128
-            o = flash_attention(q, k, v, causal=True,
-                                segment_ids=segment_ids,
-                                block_q=bs, block_k=bs)
-        else:
-            from bigdl_tpu.nn.attention import dot_product_attention
-            mask = None
-            if segment_ids is not None:
-                from bigdl_tpu.nn.attention import segment_mask
-                mask = segment_mask(segment_ids, segment_ids)
-            o = dot_product_attention(q, k, v, causal=True, mask=mask)
+        # one shared dispatch (nn.MultiHeadAttention.attend); the block
+        # keeps mha.block_size as flash TILES, never the blockwise core
+        o = mha.attend(q, k, v, segment_ids=segment_ids,
+                       allow_blockwise=False)
         o = mha.project_out(bp["attn"], o)
         if training and self.dropout > 0.0:
             rng, sub = jax.random.split(rng)

@@ -207,7 +207,43 @@ class MultiHeadAttention(Module):
             y = y + params["bo"]
         return y
 
-    def f(self, params, x, **kw):
+    def attend(self, q, k, v, *, segment_ids=None, allow_blockwise=True):
+        """The ONE attention-core dispatch shared by the module forward
+        and TransformerLM blocks: flash (per resolve_use_flash) ->
+        blockwise (pinned block_size, module path only) -> plain XLA.
+        ``segment_ids`` (B, T): packed-document isolation, self-attention
+        only — masked inside the flash tiles or via an explicit mask on
+        the plain path."""
+        if segment_ids is not None and q.shape[-2] != k.shape[-2]:
+            # mirror ops.flash_attention's guard so the XLA path fails
+            # with the same clear message instead of a deep broadcast
+            # error (and never silently masks k by q's document ids)
+            raise ValueError("segment_ids requires self-attention "
+                             "(Tq == Tk)")
+        if self.resolve_use_flash(q.shape[-2]):
+            from bigdl_tpu.ops import flash_attention
+            bs = self.block_size or 128
+            return flash_attention(q, k, v, causal=self.causal,
+                                   segment_ids=segment_ids,
+                                   block_q=bs, block_k=bs)
+        if self.block_size and allow_blockwise:
+            if segment_ids is not None:
+                raise ValueError(
+                    "segment_ids is not supported with a pinned "
+                    "block_size (blockwise-XLA core); use "
+                    "attention_impl='flash', or unset block_size for "
+                    "the plain XLA core")
+            return blockwise_attention(q, k, v, block_size=self.block_size,
+                                       causal=self.causal)
+        mask = (None if segment_ids is None
+                else segment_mask(segment_ids, segment_ids))
+        return dot_product_attention(q, k, v, causal=self.causal, mask=mask)
+
+    def f(self, params, x, *, segment_ids=None, **kw):
+        """``segment_ids`` (B, T): packed-document isolation for the
+        self-attention case — masked inside the flash tiles or via an
+        explicit mask on the XLA paths (the same contract as
+        ``ops.flash_attention`` and ``TransformerLM.doc_start_id``)."""
         from bigdl_tpu.utils.table import Table
         if isinstance(x, Table):
             q_in, k_in, v_in = x.to_seq()[:3]
@@ -216,14 +252,5 @@ class MultiHeadAttention(Module):
         else:
             q_in = k_in = v_in = x
         q, k, v = self.project_qkv(params, q_in, k_in, v_in)
-        if self.resolve_use_flash(q.shape[-2]):
-            from bigdl_tpu.ops import flash_attention
-            bs = self.block_size or 128
-            o = flash_attention(q, k, v, causal=self.causal,
-                                block_q=bs, block_k=bs)
-        elif self.block_size:
-            o = blockwise_attention(q, k, v, block_size=self.block_size,
-                                    causal=self.causal)
-        else:
-            o = dot_product_attention(q, k, v, causal=self.causal)
+        o = self.attend(q, k, v, segment_ids=segment_ids)
         return self.project_out(params, o)

@@ -278,3 +278,32 @@ class TestSegmentedSequenceParallel:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("impl", ["xla", "flash"])
+def test_mha_segment_ids(impl):
+    """nn.MultiHeadAttention.f(segment_ids=...) matches the explicit
+    mask through both cores."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.attention import segment_mask
+
+    mha = nn.MultiHeadAttention(32, 4, causal=True,
+                                attention_impl=impl).build(seed=2)
+    r = np.random.RandomState(21)
+    x = jnp.asarray(r.randn(2, 24, 32), jnp.float32)
+    seg = jnp.asarray(np.repeat(np.arange(3), 8)[None].repeat(2, 0))
+    got = mha.f(mha.params, x, segment_ids=seg)
+    q, k, v = mha.project_qkv(mha.params, x, x, x)
+    want = mha.project_out(mha.params, dot_product_attention(
+        q, k, v, causal=True, mask=segment_mask(seg, seg)))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_mha_blockwise_rejects_segments():
+    from bigdl_tpu import nn
+    mha = nn.MultiHeadAttention(32, 4, causal=True,
+                                block_size=8).build(seed=2)
+    x = jnp.zeros((1, 16, 32))
+    with pytest.raises(ValueError, match="block_size"):
+        mha.f(mha.params, x, segment_ids=jnp.zeros((1, 16), jnp.int32))
