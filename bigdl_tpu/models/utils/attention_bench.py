@@ -36,7 +36,8 @@ def _step_time(fn, q, k, v, iters: int = 5) -> float:
 
 def bench_one(impl: str, seq_len: int, batch: int, heads: int,
               head_dim: int, dtype: str, iters: int = 5,
-              block_q: int = 128, block_k: int = 128) -> dict:
+              block_q: int = 128, block_k: int = 128,
+              segmented: bool = False) -> dict:
     import jax.numpy as jnp
     import numpy as np
 
@@ -49,13 +50,18 @@ def bench_one(impl: str, seq_len: int, batch: int, heads: int,
     q = jnp.asarray(rng.randn(*shape), dt)
     k = jnp.asarray(rng.randn(*shape), dt)
     v = jnp.asarray(rng.randn(*shape), dt)
+    seg = None
+    if segmented:
+        # ~8 packed documents per window: the isolation-overhead arm
+        seg = jnp.asarray(np.sort(rng.randint(0, 8, (batch, seq_len))))
     fn = (lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                          segment_ids=seg,
                                           block_q=block_q, block_k=block_k)) \
         if impl == "flash" else \
         (lambda q, k, v: dot_product_attention(q, k, v, causal=True))
     row = {"metric": "flash_causal_train_step", "impl": impl,
            "seq_len": seq_len, "batch": batch, "heads": heads,
-           "head_dim": head_dim, "dtype": dtype,
+           "head_dim": head_dim, "dtype": dtype, "segmented": segmented,
            "block_q": block_q, "block_k": block_k, "iters": iters}
     try:
         step_s = _step_time(fn, q, k, v, iters=iters)
@@ -83,6 +89,9 @@ def main(argv=None) -> None:
                    choices=["bfloat16", "float32"])
     p.add_argument("--naive", action="store_true",
                    help="also time the O(T^2) XLA attention")
+    p.add_argument("--segmented", action="store_true",
+                   help="also time flash with packed-document segment "
+                        "masking (the isolation-overhead arm)")
     p.add_argument("--autotune", action="store_true",
                    help="sweep flash (block_q, block_k) tiles at -t and "
                         "report the fastest; grid via --tuneGrid")
@@ -152,15 +161,22 @@ def main(argv=None) -> None:
             result["summary"] = summary
         _flush_artifact(args.json, result)
 
+    impls = ["flash"]
+    if args.naive:
+        impls.append("naive_xla")
+    if args.segmented:
+        impls.append("flash_segmented")
     for t in seq_lens:
-        for impl in (["flash", "naive_xla"] if args.naive else ["flash"]):
+        for impl in impls:
             if (t, impl) in prev:
                 row = dict(prev[(t, impl)], reused_from_previous_run=True)
             else:
-                row = bench_one("flash" if impl == "flash" else "naive",
-                                t, args.batch, args.heads, args.headDim,
-                                args.dtype, iters=args.iters,
-                                block_q=args.blockQ, block_k=args.blockK)
+                row = bench_one(
+                    "flash" if impl.startswith("flash") else "naive",
+                    t, args.batch, args.heads, args.headDim,
+                    args.dtype, iters=args.iters,
+                    block_q=args.blockQ, block_k=args.blockK,
+                    segmented=impl == "flash_segmented")
                 row["impl"] = impl
             rows.append(row)
             flush()
@@ -217,7 +233,8 @@ def _autotune(args) -> None:
                     and old.get("seq_len") == args.seqLen
                     and old.get("config") == [args.batch, args.heads,
                                               args.headDim, args.dtype,
-                                              args.iters]):
+                                              args.iters,
+                                              bool(args.segmented)]):
                 for r in old.get("rows", []):
                     if "step_s" in r or _is_capacity_error(r):
                         # a tile that OOMs/fails VMEM IS a measurement —
@@ -230,7 +247,7 @@ def _autotune(args) -> None:
     result = {"metric": "flash_attention_tile_autotune",
               "platform": plat, "seq_len": args.seqLen,
               "config": [args.batch, args.heads, args.headDim, args.dtype,
-                         args.iters],
+                         args.iters, bool(args.segmented)],
               "rows": rows, "complete": False}
 
     def flush():
@@ -254,7 +271,8 @@ def _autotune(args) -> None:
         else:
             row = bench_one("flash", args.seqLen, args.batch, args.heads,
                             args.headDim, args.dtype, iters=args.iters,
-                            block_q=bq, block_k=bk)
+                            block_q=bq, block_k=bk,
+                            segmented=args.segmented)
         rows.append(row)
         flush()
         print(json.dumps(row), flush=True)
@@ -276,6 +294,12 @@ def _summarize(rows) -> list:
             entry["flash_speedup_vs_xla"] = round(n["step_s"] / f["step_s"], 3)
         elif f and "step_s" in f and n and "error" in n:
             entry["flash_speedup_vs_xla"] = "inf (xla failed: OOM-class)"
+        s = pair.get("flash_segmented")
+        if f and "step_s" in f and s and "step_s" in s:
+            # the --segmented arm's headline: isolation's cost on the
+            # flash step (1.0 = free)
+            entry["segmented_overhead_vs_flash"] = round(
+                s["step_s"] / f["step_s"], 3)
         summary.append(entry)
     return summary
 
