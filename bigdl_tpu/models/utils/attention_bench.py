@@ -103,8 +103,6 @@ def main(argv=None) -> None:
                    help="write the full sweep to this path")
     args = p.parse_args(argv)
 
-    import os
-
     import jax
 
     from bigdl_tpu.utils.engine import Engine
@@ -130,23 +128,19 @@ def main(argv=None) -> None:
     # Rows from another PLATFORM or iteration count are never reused:
     # a CPU debug sweep must not publish as TPU numbers, and a quick
     # --iters 1 smoke must not stand in for the production sample.
-    prev = {}
-    if args.json and os.path.exists(args.json):
-        try:
-            with open(args.json) as f:
-                old = json.load(f)
-            if old.get("platform") == plat:
-                for r in old.get("rows", []):
-                    if ("step_s" in r and r.get("batch") == args.batch
-                            and r.get("heads") == args.heads
-                            and r.get("head_dim") == args.headDim
-                            and r.get("dtype") == args.dtype
-                            and r.get("block_q") == args.blockQ
-                            and r.get("block_k") == args.blockK
-                            and r.get("iters") == args.iters):
-                        prev[(r.get("seq_len"), r.get("impl"))] = r
-        except (OSError, ValueError):
-            pass
+    from bigdl_tpu.utils.artifacts import load_resumable_rows
+    prev = load_resumable_rows(
+        args.json,
+        match=lambda old, r: (
+            old.get("platform") == plat and "step_s" in r
+            and r.get("batch") == args.batch
+            and r.get("heads") == args.heads
+            and r.get("head_dim") == args.headDim
+            and r.get("dtype") == args.dtype
+            and r.get("block_q") == args.blockQ
+            and r.get("block_k") == args.blockK
+            and r.get("iters") == args.iters),
+        key=lambda r: (r.get("seq_len"), r.get("impl")))
     rows = []
     result = {"platform": plat,
               "device": str(jax.devices()[0]), "rows": rows,
@@ -194,12 +188,7 @@ def _is_capacity_error(row: dict) -> bool:
                                   "too large", "exceeds"))
 
 
-def _flush_artifact(path: str, result: dict) -> None:
-    """One atomic-write path for every incremental artifact this module
-    produces (killed sweeps must keep their rows, never truncate)."""
-    if path:
-        from bigdl_tpu.utils import fs
-        fs.atomic_write(path, (json.dumps(result, indent=2) + "\n").encode())
+from bigdl_tpu.utils.artifacts import write_artifact as _flush_artifact
 
 
 def _autotune(args) -> None:
@@ -215,8 +204,6 @@ def _autotune(args) -> None:
     Incremental + resumable like the main sweep: killed mid-grid keeps
     every measured pair; OOM-class pairs record error rows (a too-big
     tile failing IS the measurement)."""
-    import os
-
     import jax
 
     plat = jax.devices()[0].platform
@@ -224,25 +211,19 @@ def _autotune(args) -> None:
     for pair in args.tuneGrid.split(","):
         bq, bk = pair.split(":")
         grid.append((int(bq), int(bk)))
-    prev = {}
-    if args.json and os.path.exists(args.json):
-        try:
-            with open(args.json) as f:
-                old = json.load(f)
-            if (old.get("platform") == plat
-                    and old.get("seq_len") == args.seqLen
-                    and old.get("config") == [args.batch, args.heads,
-                                              args.headDim, args.dtype,
-                                              args.iters,
-                                              bool(args.segmented)]):
-                for r in old.get("rows", []):
-                    if "step_s" in r or _is_capacity_error(r):
-                        # a tile that OOMs/fails VMEM IS a measurement —
-                        # reuse it; transient-looking errors (backend
-                        # died mid-compile) get retried
-                        prev[(r["block_q"], r["block_k"])] = r
-        except (OSError, ValueError):
-            pass
+    from bigdl_tpu.utils.artifacts import load_resumable_rows
+    prev = load_resumable_rows(
+        args.json,
+        # a tile that OOMs/fails VMEM IS a measurement — reuse it;
+        # transient-looking errors (backend died mid-compile) retry
+        match=lambda old, r: (
+            old.get("platform") == plat
+            and old.get("seq_len") == args.seqLen
+            and old.get("config") == [args.batch, args.heads,
+                                      args.headDim, args.dtype,
+                                      args.iters, bool(args.segmented)]
+            and ("step_s" in r or _is_capacity_error(r))),
+        key=lambda r: (r["block_q"], r["block_k"]))
     rows = []
     result = {"metric": "flash_attention_tile_autotune",
               "platform": plat, "seq_len": args.seqLen,

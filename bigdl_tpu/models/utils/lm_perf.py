@@ -107,8 +107,6 @@ def main(argv=None) -> None:
                    help="write the sweep result document to this path")
     args = p.parse_args(argv)
 
-    import os
-
     import jax
 
     from bigdl_tpu.utils.engine import Engine
@@ -129,36 +127,30 @@ def main(argv=None) -> None:
     # sweep so repeated short backend windows make net progress.  Rows
     # from another platform or iteration count never qualify (a CPU
     # debug sweep must not publish as TPU numbers).
-    prev = {}
-    if args.json and os.path.exists(args.json):
-        try:
-            with open(args.json) as f:
-                old = json.load(f)
-            if old.get("platform") == plat:
-                for r in old.get("rows", []):
-                    if ("tokens_per_s" in r and r.get("vocab") == args.vocab
-                            and r.get("hidden") == args.hidden
-                            and r.get("heads") == args.heads
-                            and r.get("layers") == args.layers
-                            and r.get("remat") == args.remat
-                            and r.get("optim") == args.optim
-                            and r.get("dtype") == args.dtype
-                            and r.get("iters") == args.iteration):
-                        prev[(r.get("seq_len"), r.get("flash"),
-                              r.get("batch"))] = r
-        except (OSError, ValueError):
-            pass
+    from bigdl_tpu.utils.artifacts import load_resumable_rows
+    prev = load_resumable_rows(
+        args.json,
+        match=lambda old, r: (
+            old.get("platform") == plat and "tokens_per_s" in r
+            and r.get("vocab") == args.vocab
+            and r.get("hidden") == args.hidden
+            and r.get("heads") == args.heads
+            and r.get("layers") == args.layers
+            and r.get("remat") == args.remat
+            and r.get("optim") == args.optim
+            and r.get("dtype") == args.dtype
+            and r.get("iters") == args.iteration),
+        key=lambda r: (r.get("seq_len"), r.get("flash"), r.get("batch")))
     rows = []
     result = {"platform": plat, "rows": rows,
               "complete": False}  # flipped by the final flush
 
+    from bigdl_tpu.utils.artifacts import write_artifact
+
     def flush():
         # rewrite after every row: a sweep killed mid-flight (flaky
         # backend window closing) keeps the rows it measured
-        if args.json:
-            from bigdl_tpu.utils import fs
-            fs.atomic_write(args.json,
-                            (json.dumps(result, indent=2) + "\n").encode())
+        write_artifact(args.json, result)
 
     for t in (int(s) for s in args.sweep.split(",")):
         for flash in (True, False):

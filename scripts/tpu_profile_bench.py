@@ -192,31 +192,24 @@ def main(argv=None) -> None:
     # the sweep, by preset+flagstring+batch for the flag experiments —
     # an edited preset definition must be re-measured, not answered
     # with the old flags' number)
-    prev_meas, prev_flags = {}, {}
-    if os.path.exists(args.json):
-        try:
-            with open(args.json) as f:
-                old = json.load(f)
-            if old.get("inner_platform", "default") == inner_platform:
-                for r in old.get("measurements", []):
-                    if r.get("images_per_s") and r.get("iters") == args.iters:
-                        prev_meas[r["batch"]] = r
-                for r in old.get("flag_sweep", []):
-                    if r.get("images_per_s") and r.get("iters") == args.iters:
-                        prev_flags[(r.get("preset"), r.get("xla_flags"),
-                                    r.get("batch"))] = r
-        except (OSError, ValueError):
-            pass
+    from bigdl_tpu.utils.artifacts import index_rows, load_artifact
+    _old = load_artifact(args.json)  # parse ONCE; two sections below
+    _ok = lambda old, r: (old.get("inner_platform", "default")  # noqa: E731
+                          == inner_platform and r.get("images_per_s")
+                          and r.get("iters") == args.iters)
+    prev_meas = index_rows(_old, section="measurements", match=_ok,
+                           key=lambda r: r["batch"])
+    prev_flags = index_rows(
+        _old, section="flag_sweep", match=_ok,
+        key=lambda r: (r.get("preset"), r.get("xla_flags"), r.get("batch")))
     result = {"metric": "resnet50_tpu_profile",
               "inner_platform": inner_platform,
               "complete": False}  # flipped by the final flush
 
+    from bigdl_tpu.utils.artifacts import write_artifact
+
     def flush():
-        from bigdl_tpu.utils import fs
-        # atomic: a kill mid-write must not leave truncated JSON that
-        # zeroes out the resume progress this file exists to carry
-        fs.atomic_write(args.json,
-                        (json.dumps(result, indent=2) + "\n").encode())
+        write_artifact(args.json, result)
 
     if not args.skip_measure:
         result["measurements"] = rows = []

@@ -61,12 +61,10 @@ def main(argv=None) -> None:
         except (OSError, ValueError):
             pass
 
+    from bigdl_tpu.utils.artifacts import write_artifact
+
     def flush():
-        if args.json:
-            with open(args.json + ".tmp", "w") as f:
-                json.dump(result, f, indent=2)
-                f.write("\n")
-            os.replace(args.json + ".tmp", args.json)
+        write_artifact(args.json, result)
 
     tries = int(result["retries"].get(str(start_mb), 0))
     if start_mb <= args.max_mb and tries >= 2:
