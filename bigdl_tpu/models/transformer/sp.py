@@ -85,19 +85,28 @@ def ulysses_lm_apply(model: TransformerLM, params, ids, mesh: Mesh, *,
 
     def attn(q, k, v, seg=None):
         return ulysses_attention_local(q, k, v, seq_axis, causal=True,
-                                       segment_ids=seg)
+                                       segment_ids_full=seg)
 
-    return _sequence_parallel_apply(model, params, ids, mesh,
-                                    seq_axis=seq_axis, data_axis=data_axis,
-                                    attn_fn=attn)
+    # the (B, T) segment ids are layer-invariant: gather them ONCE per
+    # step, outside the layer scan, instead of once per transformer layer
+    # inside ulysses_attention_local (ADVICE r4)
+    return _sequence_parallel_apply(
+        model, params, ids, mesh, seq_axis=seq_axis, data_axis=data_axis,
+        attn_fn=attn,
+        seg_prepare=lambda s: lax.all_gather(s, seq_axis, axis=1,
+                                             tiled=True))
 
 
 def _sequence_parallel_apply(model, params, ids, mesh, *, seq_axis,
-                             data_axis, attn_fn):
+                             data_axis, attn_fn, seg_prepare=None):
     """Shared shard_map body: embedding + per-shard positions, scan over
     layer-stacked blocks with ``attn_fn`` as the (sequence-sharded)
     attention core, token-local LN/MLP/head.  Validation shared by both
-    entry points lives here so the two cannot drift."""
+    entry points lives here so the two cannot drift.  ``seg_prepare``
+    transforms the (B, T_local) segment ids once per STEP, outside the
+    layer scan, for cores that need a layer-invariant derived form
+    (Ulysses pre-gathers the full (B, T) ids here rather than per
+    layer)."""
     if model.dropout > 0.0:
         raise ValueError("sequence-parallel apply does not support "
                          "dropout — build the TransformerLM with dropout=0")
@@ -147,6 +156,8 @@ def _sequence_parallel_apply(model, params, ids, mesh, *, seq_axis,
                 jnp.where(jnp.arange(n_sh)[:, None] < my, totals, 0),
                 axis=0)  # (B,)
             seg_local = local_cum + prev[:, None]
+            if seg_prepare is not None:
+                seg_local = seg_prepare(seg_local)
 
         def block(bp, h):
             a = model._layer_norm(bp["ln1"], h)

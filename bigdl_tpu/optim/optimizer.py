@@ -30,6 +30,9 @@ from bigdl_tpu.optim.validation import ValidationMethod
 log = logging.getLogger("bigdl_tpu.optim")
 
 
+_accum_fallback_warned: set = set()  # (batch_desc, dim, accum) already traced
+
+
 def accumulated_value_and_grad(loss_fn, accum, params, buffers, data,
                                labels, rng, batch_desc="batch"):
     """``(loss, new_buffers), grads`` for one batch, optionally split
@@ -52,9 +55,20 @@ def accumulated_value_and_grad(loss_fn, accum, params, buffers, data,
     host-side by the optimize loops before any work runs; ``batch_desc``
     names the axis there (under shard_map the constraint binds the
     per-device shard, not the global batch)."""
-    del batch_desc  # part of the host-side check's message, not ours
     vag = jax.value_and_grad(loss_fn, has_aux=True)
-    if accum <= 1 or jnp.asarray(data).shape[0] % accum:
+    n = jnp.asarray(data).shape[0]
+    if accum <= 1 or n % accum:
+        if accum > 1 and (batch_desc, n, accum) not in _accum_fallback_warned:
+            # the shape is static under jit, so this fires at TRACE time —
+            # once per distinct shape, not per step.  An epoch tail is
+            # expected; an irregular batch >= the steady size from a custom
+            # pipeline would otherwise silently run at full-batch
+            # activation memory.
+            _accum_fallback_warned.add((batch_desc, n, accum))
+            log.warning(
+                "gradient accumulation: %s dim %d is not divisible by "
+                "accum=%d — running this shape as ONE unaccumulated step "
+                "(full-batch activation memory)", batch_desc, n, accum)
         return vag(params, buffers, data, labels, rng)
 
     def resh(x):

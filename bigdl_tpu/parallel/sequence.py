@@ -211,13 +211,17 @@ def ring_attention(q, k, v, mesh: Mesh, *, axis: str = SEQUENCE_AXIS,
 def ulysses_attention_local(q, k, v, axis_name: str, *,
                             causal: bool = False,
                             scale: Optional[float] = None,
-                            segment_ids=None):
+                            segment_ids=None, segment_ids_full=None):
     """Per-shard body of Ulysses (all-to-all) sequence parallelism.  Inside
     ``shard_map`` with q, k, v: (B, H, T_local, D), H divisible by the axis
     size: exchange sequence shards for head shards, run full-sequence
     attention on H/N heads, exchange back.  ``segment_ids`` (B, T_local):
     each device sees the FULL sequence after the all-to-all, so the full
-    (B, T) ids are assembled with one small all_gather."""
+    (B, T) ids are assembled with one small all_gather.  The ids are
+    layer-invariant — a caller invoking this once per transformer layer
+    (e.g. inside a layer scan) should gather once and pass the (B, T)
+    result as ``segment_ids_full`` instead, skipping the per-layer
+    gather."""
     n = lax.psum(1, axis_name)
     assert q.shape[1] % n == 0, \
         f"Ulysses needs n_head ({q.shape[1]}) divisible by axis size ({n})"
@@ -236,10 +240,11 @@ def ulysses_attention_local(q, k, v, axis_name: str, *,
     if causal:
         t = qh.shape[-2]
         mask = jnp.tril(jnp.ones((t, t), bool))
-    if segment_ids is not None:
-        seg_full = lax.all_gather(segment_ids, axis_name, axis=1,
-                                  tiled=True)  # (B, T)
-        smask = segment_mask(seg_full, seg_full)
+    if segment_ids_full is None and segment_ids is not None:
+        segment_ids_full = lax.all_gather(segment_ids, axis_name, axis=1,
+                                          tiled=True)  # (B, T)
+    if segment_ids_full is not None:
+        smask = segment_mask(segment_ids_full, segment_ids_full)
         mask = smask if mask is None else jnp.logical_and(mask, smask)
     m, l, o = _block_scores(qh, kh, vh, mask, scale)
     return head2seq(_finalize(o, l))
