@@ -321,6 +321,20 @@ class Module:
         self._built()
         return caffe_loader.load(self, def_path, model_path, match_all)
 
+    def load_pytorch(self, state_dict_or_path, strict: bool = True) -> "Module":
+        """Import a PyTorch state dict (or a ``torch.save``d checkpoint
+        path) into this model — the modern pretrained-import path (ref
+        example/loadmodel/ModelValidator.scala's role; see
+        utils/torch_import.py for the positional mapping contract)."""
+        import os
+        from bigdl_tpu.utils import torch_import
+        self._built()
+        if isinstance(state_dict_or_path, (str, bytes, os.PathLike)):
+            return torch_import.load_torch_checkpoint(
+                self, state_dict_or_path, strict=strict)
+        return torch_import.load_torch_state_dict(
+            self, state_dict_or_path, strict=strict)
+
     def __getstate__(self):
         state = self.__dict__.copy()
         state["_jit_cache"] = {}  # jitted callables are not picklable

@@ -1,5 +1,6 @@
 from bigdl_tpu.utils.table import Table, T
 from bigdl_tpu.utils.engine import Engine
 from bigdl_tpu.utils import torch_file
+from bigdl_tpu.utils import torch_import
 
-__all__ = ["Table", "T", "Engine", "torch_file"]
+__all__ = ["Table", "T", "Engine", "torch_file", "torch_import"]

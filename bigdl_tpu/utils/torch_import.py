@@ -1,0 +1,176 @@
+"""Import PyTorch checkpoints into bigdl_tpu models.
+
+The modern analog of the reference's pretrained-model import path
+(ref example/loadmodel/ModelValidator.scala drives Torch/Caffe imports;
+utils/CaffeLoader.scala:61-75 copies blobs by position into the
+matching modules): today's pretrained checkpoints are PyTorch state
+dicts, so "switch from the source framework and keep your weights"
+means mapping a ``model.state_dict()`` onto a bigdl_tpu module tree.
+
+Mapping model: both frameworks enumerate parameterized modules in
+definition order — a torch ``nn.Module``'s ``state_dict()`` preserves
+registration order, and a bigdl_tpu container walks its children in
+forward order — so the i-th torch parameter GROUP (all entries sharing
+a key prefix: ``layer1.0.conv1.{weight,bias}``) corresponds to the
+i-th parameterized bigdl_tpu leaf.  Weight layouts already agree by
+construction (bigdl_tpu keeps Torch conventions for import parity:
+Linear ``(out, in)``, conv ``OIHW``, transposed conv ``(in, out, kh,
+kw)`` — see nn/linear.py, nn/conv.py), so the copy is shape-checked
+but transformation-free; BatchNorm running statistics land in the
+buffer tree.
+
+The positional contract requires the torch twin to declare its modules
+in forward order (true for torchvision-style models).  A count or
+shape mismatch raises with both sides' inventories — the same contract
+``CaffeLoader.load(match_all=true)`` enforces.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+
+#: state-dict entries that carry no weight data
+_IGNORED_SUFFIXES = ("num_batches_tracked",)
+#: suffixes that land in the buffer tree instead of params
+_BUFFER_SUFFIXES = ("running_mean", "running_var")
+
+
+def _to_numpy(v) -> np.ndarray:
+    """Accept torch tensors, numpy arrays, or anything array-like —
+    the importer itself must not require torch."""
+    if hasattr(v, "detach"):  # torch.Tensor without importing torch
+        v = v.detach().cpu()
+        try:
+            v = v.numpy()
+        except TypeError:
+            # dtypes numpy can't hold (bf16 checkpoints are common):
+            # widen to f32 — the copy is cast to the model leaf's dtype
+            # at assignment anyway
+            v = v.float().numpy()
+    return np.asarray(v)
+
+
+def group_state_dict(state_dict) -> List[Tuple[str, Dict[str, np.ndarray]]]:
+    """Group flat ``{key: tensor}`` entries by module prefix, in order of
+    first appearance: ``layer1.0.conv1.weight`` -> prefix
+    ``layer1.0.conv1``, leaf ``weight``."""
+    groups: List[Tuple[str, Dict[str, np.ndarray]]] = []
+    index: Dict[str, Dict[str, np.ndarray]] = {}
+    for key, value in state_dict.items():
+        prefix, _, leaf = key.rpartition(".")
+        if leaf in _IGNORED_SUFFIXES:
+            continue
+        if prefix not in index:
+            index[prefix] = {}
+            groups.append((prefix, index[prefix]))
+        index[prefix][leaf] = _to_numpy(value)
+    return groups
+
+
+def _walk_leaves(module, params, buffers, path):
+    """Yield (path, module, param_dict, buffer_dict) for every
+    parameterized or buffer-holding LEAF module, in forward order.
+    The yielded dicts are the live sub-dicts of the params/buffers
+    trees, so assignment into them updates the trees."""
+    children = getattr(module, "modules", None)
+    if children:
+        # containers key children "0", "1", ... (Container.init);
+        # wrapper modules (TimeDistributed, Recurrent, BiRecurrent) use
+        # named keys — resolve by matching the child into the param tree
+        keys = _child_keys(module)
+        for key, child in zip(keys, children):
+            yield from _walk_leaves(
+                child,
+                (params or {}).get(key, {}),
+                (buffers or {}).get(key, {}),
+                f"{path}.{key}" if path else key)
+        return
+    if params or buffers:
+        yield path, module, params, buffers
+
+
+def _child_keys(module) -> List[str]:
+    """Param-tree keys for a composite's children, in child order."""
+    from bigdl_tpu import nn
+    if isinstance(module, nn.TimeDistributed):
+        return ["module"]
+    if isinstance(module, nn.Recurrent):
+        return ["cell"]
+    if isinstance(module, nn.BiRecurrent):
+        return ["fwd", "bwd"]
+    return [str(i) for i in range(len(module.modules))]
+
+
+def load_torch_state_dict(model, state_dict, *, strict: bool = True):
+    """Copy a PyTorch ``state_dict`` into ``model``'s params/buffers.
+
+    ``model`` must be built (``model.build(seed)``); returns the model
+    with ``model.params`` / ``model.buffers`` holding the imported
+    values (the trees are rebuilt, not mutated in place).  With
+    ``strict`` (default, = the reference's ``match_all``) the group
+    count must match exactly; otherwise the common prefix is copied.
+    """
+    params = model._built()
+    buffers = model.buffers if model.buffers else model.init_buffers()
+    # deep-copy into mutable numpy trees so assignment is local
+    params = _copy_tree(params)
+    buffers = _copy_tree(buffers)
+
+    ours = list(_walk_leaves(model, params, buffers, ""))
+    theirs = group_state_dict(state_dict)
+    if len(ours) != len(theirs) and strict:
+        raise ValueError(
+            f"module count mismatch: model has {len(ours)} "
+            f"parameterized leaves, state_dict has {len(theirs)} "
+            f"groups\n{_inventory(ours, theirs)}")
+    for (path, mod, p_leaf, b_leaf), (prefix, group) in zip(ours, theirs):
+        for leaf_name, value in group.items():
+            target = b_leaf if leaf_name in _BUFFER_SUFFIXES else p_leaf
+            if leaf_name not in target:
+                raise ValueError(
+                    f"{prefix}.{leaf_name}: {type(mod).__name__} at "
+                    f"'{path}' has no matching slot "
+                    f"(has {sorted(target)})")
+            have = target[leaf_name]
+            if tuple(np.shape(have)) != tuple(value.shape):
+                raise ValueError(
+                    f"{prefix}.{leaf_name} -> {type(mod).__name__} at "
+                    f"'{path}': shape {tuple(value.shape)} vs expected "
+                    f"{tuple(np.shape(have))}")
+            target[leaf_name] = jnp.asarray(
+                value.astype(np.asarray(have).dtype, copy=False))
+    model.params = params
+    model.buffers = buffers
+    return model
+
+
+def load_torch_checkpoint(model, path: str, *, strict: bool = True):
+    """Load a ``torch.save``d checkpoint file (a state dict, or a dict
+    holding one under 'state_dict'/'model') into ``model``."""
+    import torch
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    for key in ("state_dict", "model"):
+        if isinstance(obj, dict) and key in obj and not hasattr(obj[key], "shape"):
+            inner = obj[key]
+            if isinstance(inner, dict):
+                obj = inner
+                break
+    return load_torch_state_dict(model, obj, strict=strict)
+
+
+def _copy_tree(t):
+    if isinstance(t, dict):
+        return {k: _copy_tree(v) for k, v in t.items()}
+    return t
+
+
+def _inventory(ours, theirs) -> str:
+    left = [f"  model[{i}] {path or '<root>'}: {type(m).__name__}"
+            f"{sorted(p) + sorted(b)}"
+            for i, (path, m, p, b) in enumerate(ours)]
+    right = [f"  torch[{i}] {prefix}: {sorted(g)}"
+             for i, (prefix, g) in enumerate(theirs)]
+    return "\n".join(left + right)

@@ -95,7 +95,6 @@ def test_oracle_sweep(name):
     tol = opts.get("tol", TOL)
     grad_tol = opts.get("grad_tol", GRAD_TOL)
     training = opts.get("training", False)
-    single = not isinstance(inputs, (list, tuple))
 
     jp = tree_np_to_jnp(params or {})
     leaves, treedef = jtu.tree_flatten(inputs)
@@ -105,12 +104,10 @@ def test_oracle_sweep(name):
         out = list(leaves)
         for i, l in zip(diff_idx, diff_leaves):
             out[i] = l
-        full = jtu.tree_unflatten(treedef, [jnp.asarray(l) for l in out])
-        return full if not single else full
+        return jtu.tree_unflatten(treedef, [jnp.asarray(l) for l in out])
 
     def fwd(p, diff_leaves):
-        xs = rebuild(diff_leaves)
-        y, _ = module.apply(p, xs if not single else xs, training=training)
+        y, _ = module.apply(p, rebuild(diff_leaves), training=training)
         return jtu.tree_leaves(detable(y))
 
     j_diff = [jnp.asarray(leaves[i]) for i in diff_idx]
@@ -119,7 +116,7 @@ def test_oracle_sweep(name):
     # torch forward on mirrored trees
     tp = tree_np_to_torch(params or {})
     txs = tree_np_to_torch(inputs)
-    t_out = torch_fn(tp, txs if not single else txs)
+    t_out = torch_fn(tp, txs)
     t_leaves = [t for t in jtu.tree_leaves(detable(t_out))]
     assert len(y_leaves) == len(t_leaves), \
         f"output arity differs: ours {len(y_leaves)} vs torch {len(t_leaves)}"
