@@ -57,15 +57,22 @@ def load(folder: str, train: bool = True) -> list[ByteRecord]:
             for i in range(len(labels))]
 
 
-def synthetic(n: int = 1024, seed: int = 0) -> list[ByteRecord]:
+def synthetic(n: int = 1024, seed: int = 0, jitter: int = 0) -> list[ByteRecord]:
     """Deterministic fake MNIST-shaped records (class-dependent blobs so a
-    model can actually learn from them)."""
+    model can actually learn from them).  ``jitter`` shifts each record's
+    blob by a per-record random offset in [-jitter, jitter] — with it the
+    task needs translation-robust features (a real generalization bar for
+    convergence proofs) instead of memorizing 10 fixed positions."""
     rng = np.random.RandomState(seed)
     records = []
     for i in range(n):
         label = i % 10
         img = rng.randint(0, 50, size=(28, 28)).astype(np.uint8)
         r, c = divmod(label, 4)
-        img[r * 8:r * 8 + 8, c * 7:c * 7 + 7] += 180
+        r0, c0 = r * 8, c * 7
+        if jitter:
+            r0 = int(np.clip(r0 + rng.randint(-jitter, jitter + 1), 0, 20))
+            c0 = int(np.clip(c0 + rng.randint(-jitter, jitter + 1), 0, 21))
+        img[r0:r0 + 8, c0:c0 + 7] += 180
         records.append(ByteRecord(img.tobytes(), float(label) + 1.0))
     return records

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -176,6 +177,16 @@ def run_scaling_sweep(model_name: str, per_chip_batch: int, iterations: int,
 
     from bigdl_tpu.utils import profiling
 
+    if ici_gbps is not None:
+        ici_gbps_source = "--ici-gbps CLI value (caller-supplied)"
+    elif os.environ.get("BIGDL_TPU_ICI_GBPS"):
+        ici_gbps_source = "BIGDL_TPU_ICI_GBPS env override"
+    else:
+        ici_gbps_source = (
+            "planning number: v5e ICI ~100 GB/s/axis peak per public TPU "
+            "specs, derated to ~90 GB/s effective "
+            "(utils/profiling.py:ICI_GBPS_DEFAULT); never measured here "
+            "— single-chip sandbox has no ICI link")
     if ici_gbps is None:
         ici_gbps = profiling.ICI_GBPS_DEFAULT
     rows = []
@@ -245,6 +256,7 @@ def run_scaling_sweep(model_name: str, per_chip_batch: int, iterations: int,
            "platform": devices[0].platform,
            "ici_model": {
                "ici_gbps": ici_gbps,
+               "ici_gbps_source": ici_gbps_source,
                "compute_s": compute_s,
                # the caller-supplied label describes assume_compute_s and
                # must not relabel a sweep-measured term

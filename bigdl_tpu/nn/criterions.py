@@ -223,11 +223,13 @@ class MultiLabelMarginCriterion(Criterion):
         # valid targets: nonzero entries before the first zero
         first_zero = jnp.cumsum(tgt == 0, axis=1) > 0
         valid = (tgt > 0) & ~first_zero
-        is_target = jnp.zeros((n, c), dtype=bool)
         idx0 = jnp.clip(tgt - 1, 0, c - 1)
-        is_target = jax.vmap(
-            lambda row, iv, vm: row.at[jnp.where(vm, iv, c - 1)].set(vm | row[jnp.where(vm, iv, c - 1)])
-        )(is_target, idx0, valid)
+        # membership by comparison, not scatter: padding slots must not
+        # collide with a real target on the clamp index (a .at[].set
+        # with duplicate indices let a padded False overwrite class C's
+        # True whenever C was a target — counting it as a non-target)
+        is_target = jnp.any(
+            valid[:, :, None] & (idx0[:, :, None] == jnp.arange(c)), axis=1)
         x_t = jnp.where(valid, jnp.take_along_axis(output, idx0, axis=1), 0.0)  # (n, K)
         # for each valid target t and each non-target j: max(0, 1 - (x_t - x_j))
         diff = 1.0 - x_t[:, :, None] + output[:, None, :]  # (n, K, C)

@@ -52,6 +52,7 @@ if [ "$SMOKE" = "1" ]; then
   PIPE_ARGS="--batch 8 --iters 2 --warmup 1 --records 64"
   PROF_ARGS="--batches 8 --iters 2 --deadline 400 --timeout 380"
   STRESS_ARGS="--max-mb 4"
+  CONV_ARGS="--lenet-epochs 1 --lenet-records 256 --vgg-epochs 1 --vgg-records 128 --batch 32"
   SCAN_ITERS=1; SCAN_STEPS=2
 else
   BENCH_FLOOR=100            # a degraded-window crawl is not a result
@@ -61,6 +62,7 @@ else
   PIPE_ARGS="--batch 256 --iters 15 --records 2048"
   PROF_ARGS="--batches 256,512,1024 --iters 15 --flag-sweep --deadline 1100 --timeout 500"
   STRESS_ARGS="--max-mb 256"
+  CONV_ARGS=""
   SCAN_ITERS=3; SCAN_STEPS=8
 fi
 
@@ -97,6 +99,7 @@ PYEOF
 ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
 PROFILE_TPU.json TUNNEL_STRESS.json \
+CONVERGENCE_r05.json CONVERGENCE_CPU.json \
 SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
 
 commit_artifacts() {  # commit_artifacts <message>
@@ -186,7 +189,7 @@ while :; do
     bonus_left=0
     { ok BENCH_SCAN.json || [ $scan_tries -ge 3 ]; } || bonus_left=1
     { ok TUNNEL_STRESS.json || [ $stress_tries -ge 3 ]; } || bonus_left=1
-    if [ $bonus_left -eq 0 ]; then
+    if [ $bonus_left -eq 0 ] && ok CONVERGENCE_r05.json; then
       commit_artifacts "TPU measurement battery: bonus diagnostics landed"
       say "opportunist COMPLETE"
       exit 0
@@ -204,7 +207,8 @@ while :; do
     # not just the tail.  Bonus diagnostics only fire once every
     # measurement artifact is in — they must never spend a scarce
     # window the measurements need.
-    if [ $all_done -eq 1 ] && ! ok BENCH_SCAN.json \
+    if [ $all_done -eq 1 ] && ok CONVERGENCE_r05.json \
+        && ! ok BENCH_SCAN.json \
         && [ $scan_tries -lt 3 ]; then
       scan_tries=$((scan_tries + 1))
       BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=$SCAN_ITERS \
@@ -227,11 +231,18 @@ while :; do
     run_stage profile PROFILE_TPU.json 1200 \
       python -u scripts/tpu_profile_bench.py \
         $PROF_ARGS --json PROFILE_TPU.json
+    # convergence proof (VERDICT r5 item 5): after the perf set, before
+    # the tunnel-risking bonuses; per-epoch checkpoints resume across
+    # windows so a closing window loses at most one epoch
+    run_stage convergence CONVERGENCE_r05.json 1200 \
+      python -u scripts/convergence_bench.py $CONV_ARGS \
+        --json CONVERGENCE_r05.json
     # LAST on purpose: if one big framed transfer is what kills the
     # relay (NOTES_r4 post-mortem), this probe is a tunnel-killer by
     # design — it must never run before the measurements it would cost.
     # It only fires at all once every measurement artifact is in.
-    if [ $all_done -eq 1 ] && ! ok TUNNEL_STRESS.json \
+    if [ $all_done -eq 1 ] && ok CONVERGENCE_r05.json \
+        && ! ok TUNNEL_STRESS.json \
         && [ $stress_tries -lt 3 ]; then
       stress_tries=$((stress_tries + 1))
       run_stage stress TUNNEL_STRESS.json 600 \
@@ -239,12 +250,14 @@ while :; do
           --json TUNNEL_STRESS.json
     fi
   else
-    if [ $regen_done -eq 1 ]; then
-      # measurements + regen are in and the backend is dead: done.  The
-      # bonus diagnostics are only worth another window if one opens on
-      # its own — they never justify holding the round open.  Commit
-      # once more: a bonus artifact landed in the same window would
-      # otherwise exit uncommitted.
+    if [ $regen_done -eq 1 ] && ok CONVERGENCE_r05.json; then
+      # measurements + regen + convergence are in and the backend is
+      # dead: done.  The bonus diagnostics are only worth another window
+      # if one opens on its own — they never justify holding the round
+      # open.  Commit once more: a bonus artifact landed in the same
+      # window would otherwise exit uncommitted.  An INCOMPLETE
+      # convergence run keeps the loop alive: its per-epoch checkpoints
+      # resume in any later window.
       commit_artifacts "TPU measurement battery: final artifact state"
       say "measurements complete, backend dead - exiting without bonus"
       exit 0

@@ -184,3 +184,106 @@ def test_criterion_backward_matches_torch(nprng):
     tx = torch.from_numpy(x).requires_grad_(True)
     F.cross_entropy(tx, torch.from_numpy(target).long() - 1).backward()
     np.testing.assert_allclose(np.asarray(ours), tx.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
+# ------------------------------------------------------------------ #
+# remaining zoo criterions (VERDICT r4: oracle every torch-expressible
+# criterion, not just the core 20)
+# ------------------------------------------------------------------ #
+def test_multilabel_margin(nprng):
+    x = _logits(nprng, 3, 6)
+    # ours: 1-based indices, 0-terminated; torch: 0-based, -1-terminated
+    y = np.array([[2, 5, 0, 0, 0, 0],
+                  [1, 0, 0, 0, 0, 0],
+                  [3, 4, 6, 0, 0, 0]], dtype=np.float32)
+    ours = nn.MultiLabelMarginCriterion().forward(jnp.asarray(x), jnp.asarray(y))
+    ref = F.multilabel_margin_loss(torch.from_numpy(x),
+                                   torch.from_numpy(y).long() - 1)
+    np.testing.assert_allclose(float(ours), float(ref), **TOL)
+
+
+def test_softmax_with_criterion_modes(nprng):
+    x = _logits(nprng, 5, 4)
+    y = np.array([1, 3, 2, 4, 2], dtype=np.float32)
+    tx, ty = torch.from_numpy(x), torch.from_numpy(y).long() - 1
+    ours = nn.SoftmaxWithCriterion().forward(jnp.asarray(x), jnp.asarray(y))
+    np.testing.assert_allclose(float(ours), float(F.cross_entropy(tx, ty)), **TOL)
+    # ignore_label + VALID: mean over non-ignored rows only
+    ours = nn.SoftmaxWithCriterion(ignore_label=2).forward(
+        jnp.asarray(x), jnp.asarray(y))
+    ref = F.cross_entropy(tx, ty, ignore_index=1)
+    np.testing.assert_allclose(float(ours), float(ref), **TOL)
+    # NONE: plain sum
+    ours = nn.SoftmaxWithCriterion(normalize_mode="NONE").forward(
+        jnp.asarray(x), jnp.asarray(y))
+    ref = F.cross_entropy(tx, ty, reduction="sum")
+    np.testing.assert_allclose(float(ours), float(ref), **TOL)
+
+
+def test_l1_hinge_embedding(nprng):
+    x1 = nprng.randn(5).astype(np.float32)
+    x2 = nprng.randn(5).astype(np.float32)
+    c = nn.L1HingeEmbeddingCriterion(margin=2.0)
+
+    def ref(y):
+        d = (torch.from_numpy(x1) - torch.from_numpy(x2)).abs().sum()
+        return d if y == 1 else torch.clamp(2.0 - d, min=0.0)
+    for y in (1, -1):
+        ours = c.forward([jnp.asarray(x1), jnp.asarray(x2)],
+                         jnp.asarray(float(y)))
+        np.testing.assert_allclose(float(ours), float(ref(y)), **TOL)
+
+
+def test_smooth_l1_with_weights(nprng):
+    x = nprng.randn(4, 6).astype(np.float32)
+    t = nprng.randn(4, 6).astype(np.float32)
+    in_w = nprng.rand(4, 6).astype(np.float32)
+    out_w = nprng.rand(4, 6).astype(np.float32)
+    sigma = 2.0
+    ours = nn.SmoothL1CriterionWithWeights(sigma=sigma, num=4).forward(
+        jnp.asarray(x), [jnp.asarray(t), jnp.asarray(in_w), jnp.asarray(out_w)])
+    tx = torch.from_numpy(x).requires_grad_(True)
+    d = torch.from_numpy(in_w) * (tx - torch.from_numpy(t))
+    s2 = sigma * sigma
+    per = torch.where(d.abs() < 1.0 / s2, 0.5 * s2 * d * d,
+                      d.abs() - 0.5 / s2)
+    ref = (torch.from_numpy(out_w) * per).sum() / 4
+    np.testing.assert_allclose(float(ours), float(ref), **TOL)
+    # gradient oracle through torch autograd
+    ref.backward()
+    g_ours = jax.grad(
+        lambda xx: nn.SmoothL1CriterionWithWeights(sigma=sigma, num=4).loss(
+            xx, [jnp.asarray(t), jnp.asarray(in_w), jnp.asarray(out_w)]))(
+        jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(g_ours), tx.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_class_simplex(nprng):
+    n = 5
+    c = nn.ClassSimplexCriterion(n)
+    simplex = np.asarray(c.simplex)
+    # spec invariants (ref ClassSimplexCriterion.scala): unit rows with
+    # pairwise dot exactly -1/n
+    np.testing.assert_allclose(np.linalg.norm(simplex, axis=1),
+                               np.ones(n), rtol=1e-5, atol=1e-5)
+    dots = simplex @ simplex.T
+    off = dots[~np.eye(n, dtype=bool)]
+    np.testing.assert_allclose(off, np.full(off.shape, -1.0 / n),
+                               rtol=1e-4, atol=1e-4)
+    # MSE mechanics against torch on the embedded targets
+    x = _logits(nprng, 3, n)
+    y = np.array([2, 5, 1], dtype=np.float32)
+    ours = c.forward(jnp.asarray(x), jnp.asarray(y))
+    ref = F.mse_loss(torch.from_numpy(x),
+                     torch.from_numpy(simplex[y.astype(int) - 1]))
+    np.testing.assert_allclose(float(ours), float(ref), **TOL)
+
+
+def test_criterion_table(nprng):
+    x1 = nprng.randn(3, 4).astype(np.float32)
+    x2 = nprng.randn(3, 4).astype(np.float32)
+    ours = nn.CriterionTable(nn.MSECriterion()).forward(
+        [jnp.asarray(x1), jnp.asarray(x2)], None)
+    ref = F.mse_loss(torch.from_numpy(x1), torch.from_numpy(x2))
+    np.testing.assert_allclose(float(ours), float(ref), **TOL)
