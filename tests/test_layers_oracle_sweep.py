@@ -89,8 +89,21 @@ def pytest_generate_tests(metafunc):
 
 
 def test_oracle_sweep(name):
+    _run_case(name)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("salt", [1, 2])
+def test_oracle_sweep_reseeded(name, salt):
+    """Same oracles under fresh weights/inputs: seed-dependent boundary
+    behavior (ties, clipping, padding interactions) must hold too."""
+    _run_case(name, salt)
+
+
+def _run_case(name, salt=0):
     fn, opts = CASES[name]
-    r = np.random.RandomState(zlib.crc32(name.encode()) & 0x7FFFFFFF)
+    r = np.random.RandomState(
+        (zlib.crc32(name.encode()) + salt) & 0x7FFFFFFF)
     module, params, inputs, torch_fn = fn(r)
     tol = opts.get("tol", TOL)
     grad_tol = opts.get("grad_tol", GRAD_TOL)
@@ -127,7 +140,8 @@ def test_oracle_sweep(name):
         return
 
     # fixed cotangents from the forward shapes
-    cr = np.random.RandomState(zlib.crc32((name + "/cot").encode()) & 0x7FFFFFFF)
+    cr = np.random.RandomState(
+        (zlib.crc32((name + "/cot").encode()) + salt) & 0x7FFFFFFF)
     cots = [cr.randn(*np.shape(y)).astype(np.float32) for y in y_leaves]
 
     def loss(p, diff_leaves):
@@ -1063,6 +1077,46 @@ def _(r):
             v_proj_weight=tp["wv"].t(), need_weights=False)
         return y.transpose(0, 1)
     return nn.MultiHeadAttention(hidden, heads, attention_impl="xla"), p, x, ref
+
+
+@case("RoiPooling", no_grad=True, tol=dict(rtol=1e-4, atol=1e-5))
+def _(r):
+    """Fast-R-CNN roi max-pool vs a literal loop twin in torch (no
+    torchvision in the sandbox; the loop IS the published algorithm)."""
+    feats = _x2(r, 2, 3, 8, 8)
+    # incl. a single-pixel roi and one extending past the image border
+    # (exercises coordinate clipping AND the empty-bin zero fill)
+    rois = np.array([[0, 0, 0, 7, 7],
+                     [1, 2, 2, 6, 5],
+                     [0, 3, 1, 4, 6],
+                     [1, 5, 5, 5, 5],
+                     [0, 6, 7, 9, 9]], dtype=np.float32)
+    ph, pw = 2, 3  # asymmetric: an h/w swap must fail on shape alone
+
+    def ref(tp, xs):
+        f, rr = xs
+        C, H, W = f.shape[1:]
+        out = []
+        for roi in rr.detach():
+            b = int(roi[0])
+            x1, y1, x2, y2 = [int(round(float(v))) for v in roi[1:]]
+            roi_h, roi_w = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+            grid = []
+            for py in range(ph):
+                row = []
+                for px in range(pw):
+                    hs = min(max(int(np.floor(py * roi_h / ph)) + y1, 0), H)
+                    he = min(max(int(np.ceil((py + 1) * roi_h / ph)) + y1, 0), H)
+                    ws = min(max(int(np.floor(px * roi_w / pw)) + x1, 0), W)
+                    we = min(max(int(np.ceil((px + 1) * roi_w / pw)) + x1, 0), W)
+                    if he > hs and we > ws:
+                        row.append(f[b][:, hs:he, ws:we].amax(dim=(1, 2)))
+                    else:
+                        row.append(torch.zeros(C))
+                grid.append(torch.stack(row, dim=-1))
+            out.append(torch.stack(grid, dim=-2))
+        return torch.stack(out)
+    return nn.RoiPooling(pw, ph), None, [feats, rois], ref
 
 
 def test_sweep_case_count():
