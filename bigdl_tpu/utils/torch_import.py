@@ -28,8 +28,12 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Tuple
 
+import logging
+
 import numpy as np
 import jax.numpy as jnp
+
+log = logging.getLogger("bigdl_tpu.torch_import")
 
 
 #: state-dict entries that carry no weight data
@@ -70,11 +74,13 @@ def group_state_dict(state_dict) -> List[Tuple[str, Dict[str, np.ndarray]]]:
     return groups
 
 
-def _walk_leaves(module, params, buffers, path):
-    """Yield (path, module, param_dict, buffer_dict) for every
-    parameterized or buffer-holding LEAF module, in forward order.
+def _walk_leaves(module, params, buffers, path, proto=None):
+    """Yield (path, module, param_dict, buffer_dict, param_proto) for
+    every parameterized or buffer-holding LEAF module, in forward order.
     The yielded dicts are the live sub-dicts of the params/buffers
-    trees, so assignment into them updates the trees."""
+    trees, so assignment into them updates the trees; ``param_proto``
+    is the leaf's definition-order key structure when a nested descent
+    already computed it (None = compute lazily if needed)."""
     children = getattr(module, "modules", None)
     if children:
         # containers key children "0", "1", ... (Container.init);
@@ -88,8 +94,56 @@ def _walk_leaves(module, params, buffers, path):
                 (buffers or {}).get(key, {}),
                 f"{path}.{key}" if path else key)
         return
+    if params and all(isinstance(v, dict) for v in params.values()):
+        # nested leaf params (Scale's {cmul: {...}, cadd: {...}}): each
+        # sub-dict is its own positional group, matching both a
+        # structure-mirroring torch twin and this module's own export.
+        # Iterate in DEFINITION order (module.init insertion order) —
+        # the params tree loses it to jax pytree key sorting the first
+        # time it passes through tree_map
+        ptree = proto if proto is not None else _init_proto(module)
+        for k in _ordered_keys(params, ptree, module, "nested param group"):
+            sub = ptree.get(k) if isinstance(ptree, dict) else None
+            yield from _walk_leaves(module, params[k],
+                                    (buffers or {}).get(k, {}),
+                                    f"{path}.{k}" if path else k,
+                                    proto=sub)
+        return
     if params or buffers:
-        yield path, module, params, buffers
+        yield path, module, params, buffers, proto
+
+
+def _init_proto(module):
+    """The definition-order key structure of ``module.init``, from a
+    DIRECT init call.  The live params tree cannot supply this: a tree
+    that has passed through any jax pytree op (``tree_map``,
+    ``eval_shape``, jit boundaries) comes back with ALPHABETICALLY
+    sorted dict keys — jax canonicalizes pytree dicts, which is exactly
+    why ``jax.eval_shape(module.init, ...)`` cannot be used here even
+    though it would skip computing the values.  A direct call returns
+    the dict exactly as init constructed it, insertion order intact;
+    the redundant weight materialization is accepted (export is a rare
+    interop operation).  None when init fails out of context."""
+    import jax
+    try:
+        return module.init(jax.random.PRNGKey(0))
+    except Exception:
+        return None
+
+
+def _ordered_keys(keys, proto, module, what) -> List[str]:
+    """``keys`` in proto's definition order; alphabetical fallback is
+    LOUD — silent alphabetical ordering is exactly the weight/bias swap
+    hazard this machinery exists to prevent."""
+    if proto is None:
+        log.warning(
+            "definition order unavailable for %s (init failed out of "
+            "context): exporting its %s in alphabetical order — verify "
+            "any positional rename onto a torch module by shape",
+            type(module).__name__, what)
+        return sorted(keys)
+    order = {k: i for i, k in enumerate(proto)}
+    return sorted(keys, key=lambda k: (order.get(k, len(order)), k))
 
 
 def _child_keys(module) -> List[str]:
@@ -126,7 +180,7 @@ def load_torch_state_dict(model, state_dict, *, strict: bool = True):
             f"module count mismatch: model has {len(ours)} "
             f"parameterized leaves, state_dict has {len(theirs)} "
             f"groups\n{_inventory(ours, theirs)}")
-    for (path, mod, p_leaf, b_leaf), (prefix, group) in zip(ours, theirs):
+    for (path, mod, p_leaf, b_leaf, _proto), (prefix, group) in zip(ours, theirs):
         for leaf_name, value in group.items():
             target = b_leaf if leaf_name in _BUFFER_SUFFIXES else p_leaf
             if leaf_name not in target:
@@ -161,6 +215,51 @@ def load_torch_checkpoint(model, path: str, *, strict: bool = True):
     return load_torch_state_dict(model, obj, strict=strict)
 
 
+def export_torch_state_dict(model) -> "dict":
+    """The reverse direction: a built model's params/buffers as a flat
+    PyTorch-convention state dict (numpy values; pass through
+    ``torch.from_numpy`` tree-wise to feed ``torch_model.load_state_dict``).
+    Keys are the model's own tree paths (``0.weight``, ``3.running_mean``
+    ...), which round-trip through :func:`load_torch_state_dict`'s
+    positional contract (nested leaf params like Scale's export as
+    ``i.cmul.weight`` and pair back as their own groups); loading into
+    an actual torch module whose prefixes differ only needs a key
+    rename, since the ORDER matches by the same definition-order
+    contract."""
+    if model.params is None:
+        # the import direction may build lazily (imported values
+        # overwrite the init), but silently exporting fresh random
+        # init as if it were trained weights is a wrong-output hazard
+        raise ValueError("model has no params to export — call "
+                         "model.build(seed) (or train it) first")
+    buffers = model.buffers if model.buffers else model.init_buffers()
+    out = {}
+    for path, mod, p_leaf, b_leaf, proto in _walk_leaves(
+            model, model.params, buffers, ""):
+        # _walk_leaves descends into nested leaf dicts, so values here
+        # are always arrays.  Emit params in DEFINITION order (weight
+        # before bias, w_ih before w_hh before bias, ...): the live
+        # tree's key order is alphabetical after any tree_map, and a
+        # positional rename onto a torch twin depends on this order
+        if len(p_leaf) > 1 and proto is None:
+            proto = _init_proto(mod)
+        names = (list(p_leaf) if len(p_leaf) < 2
+                 else _ordered_keys(p_leaf, proto, mod, "params"))
+        for name in names:
+            out[f"{path}.{name}" if path else name] = np.asarray(p_leaf[name])
+        bproto = None
+        if len(b_leaf) > 1:
+            try:  # direct call: eval_shape would sort the keys (above)
+                bproto = mod.init_buffers()
+            except Exception:
+                bproto = None
+        bnames = (list(b_leaf) if len(b_leaf) < 2
+                  else _ordered_keys(b_leaf, bproto, mod, "buffers"))
+        for name in bnames:
+            out[f"{path}.{name}" if path else name] = np.asarray(b_leaf[name])
+    return out
+
+
 def _copy_tree(t):
     if isinstance(t, dict):
         return {k: _copy_tree(v) for k, v in t.items()}
@@ -170,7 +269,7 @@ def _copy_tree(t):
 def _inventory(ours, theirs) -> str:
     left = [f"  model[{i}] {path or '<root>'}: {type(m).__name__}"
             f"{sorted(p) + sorted(b)}"
-            for i, (path, m, p, b) in enumerate(ours)]
+            for i, (path, m, p, b, _pr) in enumerate(ours)]
     right = [f"  torch[{i}] {prefix}: {sorted(g)}"
              for i, (prefix, g) in enumerate(theirs)]
     return "\n".join(left + right)

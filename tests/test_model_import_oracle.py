@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from bigdl_tpu import nn
 from bigdl_tpu.models.alexnet import AlexNet
 from bigdl_tpu.models.resnet import ResNet
-from bigdl_tpu.utils.torch_import import (group_state_dict,
+from bigdl_tpu.utils.torch_import import (export_torch_state_dict,
+                                          group_state_dict,
                                           load_torch_state_dict)
 
 # whole-net fp32 tolerance: hundreds of accumulated convs/GEMMs diverge
@@ -257,6 +258,67 @@ def test_shape_mismatch_raises():
           "fc.bias": np.zeros(5, np.float32)}
     with pytest.raises(ValueError, match="shape"):
         load_torch_state_dict(model, sd)
+
+
+def test_export_state_dict_roundtrip_to_torch():
+    """Reverse direction: OUR trained weights load into the torch twin
+    and reproduce our predictions (the export half of the interop
+    story; same mechanism as the reference's saveTorch)."""
+    torch.manual_seed(11)
+    model = nn.Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3),
+        nn.ReLU(),
+        nn.SpatialBatchNormalization(4),
+        nn.View(4 * 6 * 6),
+        nn.Linear(4 * 6 * 6, 5),
+        nn.LogSoftMax()).build(3)
+    sd = export_torch_state_dict(model)
+    twin = torch.nn.Sequential(
+        torch.nn.Conv2d(1, 4, 3), torch.nn.ReLU(), torch.nn.BatchNorm2d(4),
+        torch.nn.Flatten(), torch.nn.Linear(4 * 6 * 6, 5),
+        torch.nn.LogSoftmax(dim=-1))
+    # rename positional keys onto the twin's own names, order-aligned
+    twin_keys = [k for k in twin.state_dict() if "num_batches" not in k]
+    assert len(twin_keys) == len(sd)
+    mapped = {tk: torch.from_numpy(v.copy())
+              for tk, v in zip(twin_keys, sd.values())}
+    twin.load_state_dict(mapped, strict=False)
+    twin.eval()
+    x = np.random.RandomState(2).randn(3, 1, 8, 8).astype(np.float32)
+    ours = _predict_ours(model, x)
+    with torch.no_grad():
+        ref = twin(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_export_roundtrip_nested_leaf_params():
+    """Scale holds nested {cmul, cadd} param dicts: export and the
+    positional loader must agree on the grouping."""
+    m1 = nn.Sequential(nn.Linear(3, 4), nn.Scale((4,))).build(0)
+    sd = export_torch_state_dict(m1)
+    assert "1.cmul.weight" in sd and "1.cadd.bias" in sd
+    m2 = nn.Sequential(nn.Linear(3, 4), nn.Scale((4,))).build(9)
+    load_torch_state_dict(m2, sd)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3).astype(np.float32))
+    y1, _ = m1.apply(m1.params, x, training=False)
+    y2, _ = m2.apply(m2.params, x, training=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_export_key_order_survives_tree_map():
+    """jax pytree ops return dicts with ALPHABETICAL keys (bias before
+    weight); export must emit definition order regardless, or a
+    positional rename onto a torch twin swaps weight and bias."""
+    import jax
+    model = nn.Sequential(nn.Linear(3, 4)).build(0)
+    model.params = jax.tree_util.tree_map(lambda w: w * 1.0, model.params)
+    assert list(model.params["0"]) == ["bias", "weight"]  # the hazard
+    assert list(export_torch_state_dict(model)) == ["0.weight", "0.bias"]
+
+
+def test_export_unbuilt_model_raises():
+    with pytest.raises(ValueError, match="no params to export"):
+        export_torch_state_dict(nn.Sequential(nn.Linear(3, 4)))
 
 
 def test_non_strict_partial_import():
