@@ -1,0 +1,127 @@
+"""Whole-model GPT-2 import oracle: TransformerLM vs the LIVE Hugging
+Face implementation.
+
+Extends the ModelValidator-equivalent story (test_model_import_oracle)
+to the transformer family: a randomly-initialized-but-real
+``GPT2LMHeadModel`` (no network egress needed — built from config)
+exports its state dict, ``load_gpt2_state_dict`` maps it onto our
+scan-stacked layout, and the two implementations must agree on
+log-probabilities and next-token ranking end to end.  This oracles the
+fused-qkv split, the per-layer stack onto the lax.scan axis, pre-LN
+residual order, tanh-GELU, tied embeddings, and the learned-position
+slice in one shot.
+"""
+import numpy as np
+import pytest
+import torch
+
+import jax.numpy as jnp
+
+torch.manual_seed(0)
+transformers = pytest.importorskip("transformers")
+
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.models.transformer.io import load_gpt2_state_dict
+
+V, H, L, HEADS, T = 97, 32, 2, 2, 24
+
+
+def _hf_model():
+    cfg = transformers.GPT2Config(
+        vocab_size=V, n_positions=64, n_embd=H, n_layer=L, n_head=HEADS,
+        activation_function="gelu_new",
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    return transformers.GPT2LMHeadModel(cfg).eval()
+
+
+@pytest.fixture(scope="module")
+def pair():
+    hf = _hf_model()
+    model = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                          n_layers=L, max_len=64, dropout=0.0,
+                          tie_embeddings=True, pos_encoding="learned",
+                          attention_impl="xla").build(0)
+    load_gpt2_state_dict(model, hf.state_dict())
+    return model, hf
+
+
+def test_gpt2_import_logprob_parity(pair):
+    model, hf = pair
+    ids0 = np.random.RandomState(5).randint(0, V, (3, T))
+    with torch.no_grad():
+        ref = hf(torch.from_numpy(ids0)).logits
+        ref_logp = torch.log_softmax(ref, dim=-1).numpy()
+    ours, _ = model.apply(model.params, jnp.asarray(ids0 + 1),  # 1-based
+                          training=False)
+    np.testing.assert_allclose(np.asarray(ours), ref_logp,
+                               rtol=1e-3, atol=1e-4)
+    assert (np.asarray(ours).argmax(-1) == ref_logp.argmax(-1)).all()
+
+
+def test_gpt2_import_shape_mismatch_raises(pair):
+    _, hf = pair
+    small = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                          n_layers=L, max_len=64,
+                          pos_encoding="learned").build(0)
+    sd = {k: v for k, v in hf.state_dict().items()}
+    sd["transformer.wte.weight"] = torch.zeros(V + 1, H)
+    with pytest.raises(ValueError, match="wte.weight"):
+        load_gpt2_state_dict(small, sd)
+
+
+def test_gpt2_import_rope_model_rejected(pair):
+    _, hf = pair
+    rope = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                         n_layers=L, max_len=64,
+                         pos_encoding="rope").build(0)
+    with pytest.raises(ValueError, match="learned"):
+        load_gpt2_state_dict(rope, hf.state_dict())
+
+
+def test_gpt2_import_diverged_head_into_tied_model_rejected(pair):
+    _, hf = pair
+    sd = {k: v.clone() for k, v in hf.state_dict().items()}
+    sd["lm_head.weight"] = sd["lm_head.weight"] + 1.0  # untied fine-tune
+    tied = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                         n_layers=L, max_len=64, tie_embeddings=True,
+                         pos_encoding="learned").build(0)
+    with pytest.raises(ValueError, match="tie_embeddings=False"):
+        load_gpt2_state_dict(tied, sd)
+
+
+def test_gpt2_import_moe_model_rejected(pair):
+    _, hf = pair
+    moe = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                        n_layers=L, max_len=64, moe_experts=2,
+                        pos_encoding="learned").build(0)
+    with pytest.raises(ValueError, match="moe"):
+        load_gpt2_state_dict(moe, hf.state_dict())
+
+
+def test_gpt2_import_missing_wpe_clear_error(pair):
+    _, hf = pair
+    sd = {k: v for k, v in hf.state_dict().items()
+          if "wpe" not in k}
+    m = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                      n_layers=L, max_len=64,
+                      pos_encoding="learned").build(0)
+    with pytest.raises(ValueError, match="wpe.weight"):
+        load_gpt2_state_dict(m, sd)
+
+
+def test_gpt2_import_untied_head():
+    hf = _hf_model()
+    model = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                          n_layers=L, max_len=64, tie_embeddings=False,
+                          pos_encoding="learned",
+                          attention_impl="xla").build(1)
+    load_gpt2_state_dict(model, hf.state_dict())
+    # GPT-2 ties lm_head to wte, so the untied import must still agree
+    ids0 = np.random.RandomState(6).randint(0, V, (2, T))
+    with torch.no_grad():
+        ref_logp = torch.log_softmax(
+            hf(torch.from_numpy(ids0)).logits, dim=-1).numpy()
+    ours, _ = model.apply(model.params, jnp.asarray(ids0 + 1),
+                          training=False)
+    np.testing.assert_allclose(np.asarray(ours), ref_logp,
+                               rtol=1e-3, atol=1e-4)
