@@ -137,3 +137,54 @@ def load_gpt2_state_dict(model, state_dict) -> "TransformerLM":
 
     model.params = params
     return model
+
+
+def export_gpt2_state_dict(model) -> Dict[str, np.ndarray]:
+    """The reverse: a built ``TransformerLM``'s params as a GPT-2-layout
+    state dict (numpy values, ``GPT2Model`` key convention — prepend
+    ``transformer.`` and mirror ``lm_head.weight`` from ``wte.weight``
+    for a ``GPT2LMHeadModel``).  Per-layer tensors unstack from the
+    scan axis; q/k/v projections fuse back into ``c_attn``.  Round-trip
+    and HF-load oracled in tests/test_transformer_gpt2_oracle.py."""
+    if model.params is None:
+        raise ValueError("model has no params to export — call "
+                         "model.build(seed) (or train it) first")
+    if model.moe_experts:
+        raise ValueError("MoE blocks have no GPT-2 layout")
+    if model.pos_encoding != "learned":
+        raise ValueError("GPT-2's layout carries learned positions — "
+                         "rope models cannot export to it")
+    p = model.params
+    out: Dict[str, np.ndarray] = {
+        "wte.weight": np.asarray(p["embed"], np.float32),
+        "wpe.weight": np.asarray(p["pos"], np.float32),
+    }
+    blocks = p["blocks"]
+
+    def as32(x):
+        return np.asarray(x, np.float32)
+
+    for i in range(model.n_layers):
+        pre = f"h.{i}."
+        a = blocks["attn"]
+        out[pre + "ln_1.weight"] = as32(blocks["ln1"]["weight"][i])
+        out[pre + "ln_1.bias"] = as32(blocks["ln1"]["bias"][i])
+        out[pre + "attn.c_attn.weight"] = np.concatenate(
+            [as32(a["wq"][i]), as32(a["wk"][i]),
+             as32(a["wv"][i])], axis=1)
+        out[pre + "attn.c_attn.bias"] = np.concatenate(
+            [as32(a["bq"][i]), as32(a["bk"][i]),
+             as32(a["bv"][i])])
+        out[pre + "attn.c_proj.weight"] = as32(a["wo"][i])
+        out[pre + "attn.c_proj.bias"] = as32(a["bo"][i])
+        out[pre + "ln_2.weight"] = as32(blocks["ln2"]["weight"][i])
+        out[pre + "ln_2.bias"] = as32(blocks["ln2"]["bias"][i])
+        out[pre + "mlp.c_fc.weight"] = as32(blocks["w1"][i])
+        out[pre + "mlp.c_fc.bias"] = as32(blocks["b1"][i])
+        out[pre + "mlp.c_proj.weight"] = as32(blocks["w2"][i])
+        out[pre + "mlp.c_proj.bias"] = as32(blocks["b2"][i])
+    out["ln_f.weight"] = np.asarray(p["ln_f"]["weight"], np.float32)
+    out["ln_f.bias"] = np.asarray(p["ln_f"]["bias"], np.float32)
+    if not model.tie_embeddings:
+        out["lm_head.weight"] = np.asarray(p["head"], np.float32).T
+    return out

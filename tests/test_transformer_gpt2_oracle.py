@@ -21,7 +21,8 @@ torch.manual_seed(0)
 transformers = pytest.importorskip("transformers")
 
 from bigdl_tpu.models.transformer import TransformerLM
-from bigdl_tpu.models.transformer.io import load_gpt2_state_dict
+from bigdl_tpu.models.transformer.io import (export_gpt2_state_dict,
+                                             load_gpt2_state_dict)
 
 V, H, L, HEADS, T = 97, 32, 2, 2, 24
 
@@ -125,3 +126,74 @@ def test_gpt2_import_untied_head():
                           training=False)
     np.testing.assert_allclose(np.asarray(ours), ref_logp,
                                rtol=1e-3, atol=1e-4)
+
+
+def test_gpt2_export_loads_into_live_hf():
+    """OUR TransformerLM weights, exported in GPT-2 layout, load into a
+    live HF GPT2LMHeadModel and reproduce our log-probs — the reverse
+    interop direction, with HF as the executing oracle."""
+    model = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                          n_layers=L, max_len=64, dropout=0.0,
+                          tie_embeddings=True, pos_encoding="learned",
+                          attention_impl="xla").build(7)
+    sd = export_gpt2_state_dict(model)
+    cfg = transformers.GPT2Config(
+        vocab_size=V, n_positions=64, n_embd=H, n_layer=L, n_head=HEADS,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    hf.transformer.load_state_dict(
+        {k: torch.from_numpy(v.copy()) for k, v in sd.items()},
+        strict=False)
+    hf.tie_weights()  # lm_head <- wte, matching our tie_embeddings
+    hf.eval()
+    ids0 = np.random.RandomState(8).randint(0, V, (2, T))
+    with torch.no_grad():
+        ref_logp = torch.log_softmax(
+            hf(torch.from_numpy(ids0)).logits, dim=-1).numpy()
+    ours, _ = model.apply(model.params, jnp.asarray(ids0 + 1),
+                          training=False)
+    np.testing.assert_allclose(np.asarray(ours), ref_logp,
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_gpt2_export_import_roundtrip(pair):
+    model, _ = pair
+    sd = export_gpt2_state_dict(model)
+    clone = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                          n_layers=L, max_len=64, tie_embeddings=True,
+                          pos_encoding="learned",
+                          attention_impl="xla").build(9)
+    load_gpt2_state_dict(clone, sd)
+    ids = jnp.asarray(np.random.RandomState(4).randint(1, V + 1, (2, T)))
+    y1, _ = model.apply(model.params, ids, training=False)
+    y2, _ = clone.apply(clone.params, ids, training=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_gpt2_export_untied_roundtrip_and_bf16_cast():
+    """Untied head export (the .T-sensitive branch) round-trips; a
+    bf16-cast params tree exports as float32 throughout (torch cannot
+    hold ml_dtypes bfloat16 numpy arrays)."""
+    model = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                          n_layers=L, max_len=64, tie_embeddings=False,
+                          pos_encoding="learned",
+                          attention_impl="xla").build(13)
+    import jax
+    model.params = jax.tree_util.tree_map(
+        lambda w: w.astype(jnp.bfloat16), model.params)
+    sd = export_gpt2_state_dict(model)
+    assert all(v.dtype == np.float32 for v in sd.values()), \
+        {k: str(v.dtype) for k, v in sd.items() if v.dtype != np.float32}
+    clone = TransformerLM(vocab_size=V, hidden_size=H, n_head=HEADS,
+                          n_layers=L, max_len=64, tie_embeddings=False,
+                          pos_encoding="learned",
+                          attention_impl="xla").build(14)
+    load_gpt2_state_dict(clone, sd)
+    ids = jnp.asarray(np.random.RandomState(3).randint(1, V + 1, (2, T)))
+    y1, _ = model.apply(model.params, ids, training=False)
+    y2, _ = clone.apply(clone.params, ids, training=False)
+    # bf16 forward vs the f32 round-trip of the same (bf16-valued) weights
+    np.testing.assert_allclose(np.asarray(y1, np.float32), np.asarray(y2),
+                               rtol=5e-2, atol=5e-2)
+    assert (np.asarray(y1).argmax(-1) == np.asarray(y2).argmax(-1)).mean() > 0.9
