@@ -327,3 +327,48 @@ def test_non_strict_partial_import():
     sd = {"fc1.weight": w, "fc1.bias": np.zeros(4, np.float32)}
     load_torch_state_dict(model, sd, strict=False)
     np.testing.assert_array_equal(np.asarray(model.params["0"]["weight"]), w)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_export_import_roundtrip_random_compositions(seed):
+    """Composition fuzzer for the positional walk: randomly nested
+    containers (Sequential depth, ConcatTable+JoinTable branches,
+    parameterized and param-free layers interleaved) must round-trip
+    export -> load with bit-exact predictions."""
+    r = np.random.RandomState(100 + seed)
+
+    def random_tail(dim, depth):
+        mods = []
+        for _ in range(r.randint(1, 4)):
+            kind = r.randint(0, 4)
+            if kind == 0:
+                out = int(r.randint(2, 7))
+                mods.append(nn.Linear(dim, out))
+                dim = out
+            elif kind == 1:
+                mods.append(nn.Tanh())
+            elif kind == 2:
+                mods.append(nn.BatchNormalization(dim))
+            elif kind == 3 and depth > 0:
+                out = int(r.randint(2, 7))
+                branch1, d1 = random_tail(dim, depth - 1)
+                branch2 = nn.Linear(dim, d1)  # align widths for join
+                mods.append(nn.Sequential(
+                    nn.ConcatTable(nn.Sequential(*branch1), branch2),
+                    nn.JoinTable(2)))
+                dim = 2 * d1
+        return mods, dim
+
+    mods, out_dim = random_tail(5, 2)
+    model = nn.Sequential(*mods).build(seed)
+    from bigdl_tpu.utils.torch_import import export_torch_state_dict
+    sd = export_torch_state_dict(model)
+    # a structurally identical fresh model: rebuild from the same recipe
+    r = np.random.RandomState(100 + seed)
+    mods2, _ = random_tail(5, 2)
+    clone = nn.Sequential(*mods2).build(seed + 999)
+    load_torch_state_dict(clone, sd)
+    x = jnp.asarray(np.random.RandomState(7).randn(3, 5).astype(np.float32))
+    y1, _ = model.apply(model.params, x, buffers=model.buffers, training=False)
+    y2, _ = clone.apply(clone.params, x, buffers=clone.buffers, training=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
