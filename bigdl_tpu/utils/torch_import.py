@@ -57,6 +57,44 @@ def _to_numpy(v) -> np.ndarray:
     return np.asarray(v)
 
 
+def chunked_device_array(a, dtype=None, limit_bytes=32 << 20,
+                         force=False):
+    """Device array from host data in <=32 MB leading-axis slices, one
+    in flight at a time — the tunneled TPU relay dies on large single
+    host->device transfers (~154 MB killed round 4's; NOTES_r4.md), and
+    GPT-2-scale embeddings/projections are exactly that size.  Same
+    pattern as bench.py's chunked input upload.  Single-shot for small
+    arrays and on CPU."""
+    import jax
+    a = np.asarray(a, dtype) if dtype is not None else np.asarray(a)
+    if not force and (a.ndim == 0 or a.nbytes <= limit_bytes
+                      or jax.devices()[0].platform == "cpu"):
+        return jnp.asarray(a)
+    rows = max(1, limit_bytes // max(a[0:1].nbytes, 1))
+    parts = []
+    for i in range(0, a.shape[0], rows):
+        p = jnp.asarray(a[i:i + rows])
+        p.block_until_ready()  # one in-flight slice at a time
+        parts.append(p)
+    out = jnp.concatenate(parts, axis=0)
+    out.block_until_ready()
+    return out
+
+
+def read_torch_checkpoint(path):
+    """``torch.load`` a checkpoint file and unwrap the common trainer
+    wrapper keys ('state_dict', 'model') down to the flat state dict."""
+    import torch
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    for key in ("state_dict", "model"):
+        if isinstance(obj, dict) and key in obj and not hasattr(obj[key], "shape"):
+            inner = obj[key]
+            if isinstance(inner, dict):
+                obj = inner
+                break
+    return obj
+
+
 def group_state_dict(state_dict) -> List[Tuple[str, Dict[str, np.ndarray]]]:
     """Group flat ``{key: tensor}`` entries by module prefix, in order of
     first appearance: ``layer1.0.conv1.weight`` -> prefix
@@ -194,7 +232,7 @@ def load_torch_state_dict(model, state_dict, *, strict: bool = True):
                     f"{prefix}.{leaf_name} -> {type(mod).__name__} at "
                     f"'{path}': shape {tuple(value.shape)} vs expected "
                     f"{tuple(np.shape(have))}")
-            target[leaf_name] = jnp.asarray(
+            target[leaf_name] = chunked_device_array(
                 value.astype(np.asarray(have).dtype, copy=False))
     model.params = params
     model.buffers = buffers
@@ -204,15 +242,8 @@ def load_torch_state_dict(model, state_dict, *, strict: bool = True):
 def load_torch_checkpoint(model, path: str, *, strict: bool = True):
     """Load a ``torch.save``d checkpoint file (a state dict, or a dict
     holding one under 'state_dict'/'model') into ``model``."""
-    import torch
-    obj = torch.load(path, map_location="cpu", weights_only=True)
-    for key in ("state_dict", "model"):
-        if isinstance(obj, dict) and key in obj and not hasattr(obj[key], "shape"):
-            inner = obj[key]
-            if isinstance(inner, dict):
-                obj = inner
-                break
-    return load_torch_state_dict(model, obj, strict=strict)
+    return load_torch_state_dict(model, read_torch_checkpoint(path),
+                                 strict=strict)
 
 
 def export_torch_state_dict(model) -> "dict":

@@ -372,3 +372,16 @@ def test_export_import_roundtrip_random_compositions(seed):
     y1, _ = model.apply(model.params, x, buffers=model.buffers, training=False)
     y2, _ = clone.apply(clone.params, x, buffers=clone.buffers, training=False)
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_chunked_device_array_slicing():
+    """The <=limit leading-axis slicing reassembles exactly (force=True
+    exercises the chunk path on CPU, where it normally short-circuits)."""
+    from bigdl_tpu.utils.torch_import import chunked_device_array
+    a = np.arange(7 * 5, dtype=np.float32).reshape(7, 5)
+    out = chunked_device_array(a, limit_bytes=2 * 5 * 4, force=True)  # 2 rows/slice
+    np.testing.assert_array_equal(np.asarray(out), a)
+    small = chunked_device_array(a)
+    np.testing.assert_array_equal(np.asarray(small), a)
+    scalar = chunked_device_array(np.float32(3.0))
+    assert float(scalar) == 3.0
