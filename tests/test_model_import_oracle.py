@@ -385,3 +385,84 @@ def test_chunked_device_array_slicing():
     np.testing.assert_array_equal(np.asarray(small), a)
     scalar = chunked_device_array(np.float32(3.0))
     assert float(scalar) == 3.0
+
+
+# --------------------------------------------------------------------- #
+# Inception-v1 (config #4's family) + the NHWC interchange claim        #
+# --------------------------------------------------------------------- #
+class _TorchInceptionModule(torch.nn.Module):
+    """Branch order mirrors our Concat child order (definition order =
+    state-dict order = the positional walk's pairing order)."""
+
+    def __init__(self, n_in, cfg):
+        super().__init__()
+        (c1,), (c3r, c3), (c5r, c5), (cp,) = cfg
+        S, C, R = torch.nn.Sequential, torch.nn.Conv2d, torch.nn.ReLU
+        self.b1 = S(C(n_in, c1, 1), R())
+        self.b2 = S(C(n_in, c3r, 1), R(), C(c3r, c3, 3, padding=1), R())
+        self.b3 = S(C(n_in, c5r, 1), R(), C(c5r, c5, 5, padding=2), R())
+        self.b4 = S(torch.nn.MaxPool2d(3, 1, 1, ceil_mode=True),
+                    C(n_in, cp, 1), R())
+
+    def forward(self, x):
+        return torch.cat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], 1)
+
+
+def _torch_inception_v1(n_classes):
+    S = torch.nn.Sequential
+    mods = [torch.nn.Conv2d(3, 64, 7, 2, 3), torch.nn.ReLU(),
+            torch.nn.MaxPool2d(3, 2, ceil_mode=True),
+            torch.nn.LocalResponseNorm(5, alpha=0.0001, beta=0.75, k=1.0),
+            torch.nn.Conv2d(64, 64, 1), torch.nn.ReLU(),
+            torch.nn.Conv2d(64, 192, 3, padding=1), torch.nn.ReLU(),
+            torch.nn.LocalResponseNorm(5, alpha=0.0001, beta=0.75, k=1.0),
+            torch.nn.MaxPool2d(3, 2, ceil_mode=True),
+            _TorchInceptionModule(192, ((64,), (96, 128), (16, 32), (32,))),
+            _TorchInceptionModule(256, ((128,), (128, 192), (32, 96), (64,))),
+            torch.nn.MaxPool2d(3, 2, ceil_mode=True),
+            _TorchInceptionModule(480, ((192,), (96, 208), (16, 48), (64,))),
+            _TorchInceptionModule(512, ((160,), (112, 224), (24, 64), (64,))),
+            _TorchInceptionModule(512, ((128,), (128, 256), (24, 64), (64,))),
+            _TorchInceptionModule(512, ((112,), (144, 288), (32, 64), (64,))),
+            _TorchInceptionModule(528, ((256,), (160, 320), (32, 128), (128,))),
+            torch.nn.MaxPool2d(3, 2, ceil_mode=True),
+            _TorchInceptionModule(832, ((256,), (160, 320), (32, 128), (128,))),
+            _TorchInceptionModule(832, ((384,), (192, 384), (48, 128), (128,))),
+            torch.nn.AvgPool2d(7),
+            torch.nn.Dropout(0.4),
+            torch.nn.Flatten(),
+            torch.nn.Linear(1024, n_classes),
+            torch.nn.LogSoftmax(dim=-1)]
+    return S(*mods)
+
+
+@pytest.mark.slow
+def test_inception_v1_state_dict_import_parity():
+    """ModelValidator parity for the GoogLeNet family (BASELINE config
+    #4): 57 conv/linear leaves across 9 four-branch Concat modules."""
+    from bigdl_tpu.models.inception import Inception_v1
+    torch.manual_seed(15)
+    twin = _torch_inception_v1(10).eval()
+    model = Inception_v1(10).build(0)
+    load_torch_state_dict(model, twin.state_dict())
+    x = np.random.RandomState(4).randn(2, 3, 224, 224).astype(np.float32) * 0.1
+    with torch.no_grad():
+        ref = twin(torch.from_numpy(x)).numpy()
+    _assert_prediction_parity(_predict_ours(model, x), ref)
+
+
+def test_resnet18_nhwc_import_same_checkpoint():
+    """The NHWC (TPU-fast) variant keeps an identical param tree, so
+    the SAME torch checkpoint imports into it and predicts identically
+    (modulo the input layout transpose) — the interchange claim in
+    models/resnet's docstring."""
+    torch.manual_seed(18)
+    twin = _torch_resnet(18, 10).eval()
+    model = ResNet(class_num=10, depth=18, shortcut_type="B",
+                   dataset="imagenet", data_format="NHWC").build(0)
+    load_torch_state_dict(model, twin.state_dict())
+    x = np.random.RandomState(12).randn(2, 3, 224, 224).astype(np.float32)
+    with torch.no_grad():
+        ref = twin(torch.from_numpy(x)).numpy()
+    ours = _predict_ours(model, x.transpose(0, 2, 3, 1))  # NHWC input
+    _assert_prediction_parity(ours, ref)
