@@ -466,3 +466,65 @@ def test_resnet18_nhwc_import_same_checkpoint():
         ref = twin(torch.from_numpy(x)).numpy()
     ours = _predict_ours(model, x.transpose(0, 2, 3, 1))  # NHWC input
     _assert_prediction_parity(ours, ref)
+
+
+# --------------------------------------------------------------------- #
+# LeNet-5 (config #1) and VggForCifar10 (config #2) twins — with these,
+# every BASELINE.json config family has a whole-net import oracle
+# --------------------------------------------------------------------- #
+def test_lenet5_state_dict_import_parity():
+    from bigdl_tpu.models.lenet import LeNet5
+    torch.manual_seed(22)
+    twin = torch.nn.Sequential(
+        torch.nn.Conv2d(1, 6, 5), torch.nn.Tanh(),
+        torch.nn.MaxPool2d(2, 2),
+        torch.nn.Conv2d(6, 12, 5), torch.nn.Tanh(),
+        torch.nn.MaxPool2d(2, 2),
+        torch.nn.Flatten(),
+        torch.nn.Linear(12 * 4 * 4, 100), torch.nn.Tanh(),
+        torch.nn.Linear(100, 10),
+        torch.nn.LogSoftmax(dim=-1)).eval()
+    model = LeNet5(10).build(0)
+    load_torch_state_dict(model, twin.state_dict())
+    x = np.random.RandomState(1).randn(4, 1, 28, 28).astype(np.float32)
+    with torch.no_grad():
+        ref = twin(torch.from_numpy(x)).numpy()
+    # our LeNet5 reshapes (B,1,28,28) itself from flat input
+    _assert_prediction_parity(_predict_ours(model, x.reshape(4, -1)), ref)
+
+
+def test_vgg_cifar_state_dict_import_parity():
+    from bigdl_tpu.models.vgg import VggForCifar10
+    torch.manual_seed(23)
+    cfg = [(3, 64), (64, 64), "M", (64, 128), (128, 128), "M",
+           (128, 256), (256, 256), (256, 256), "M",
+           (256, 512), (512, 512), (512, 512), "M",
+           (512, 512), (512, 512), (512, 512), "M"]
+    mods = []
+    for item in cfg:
+        if item == "M":
+            mods.append(torch.nn.MaxPool2d(2, 2, ceil_mode=True))
+        else:
+            n_in, n_out = item
+            mods += [torch.nn.Conv2d(n_in, n_out, 3, padding=1),
+                     torch.nn.BatchNorm2d(n_out, eps=1e-3),
+                     torch.nn.ReLU()]
+    mods += [torch.nn.Flatten(), torch.nn.Dropout(0.5),
+             torch.nn.Linear(512, 512), torch.nn.BatchNorm1d(512),
+             torch.nn.ReLU(), torch.nn.Dropout(0.5),
+             torch.nn.Linear(512, 10), torch.nn.LogSoftmax(dim=-1)]
+    twin = torch.nn.Sequential(*mods)
+    # warm BN running stats so the buffer import is load-bearing
+    twin.train()
+    with torch.no_grad():
+        for i in range(2):
+            twin(torch.from_numpy(
+                np.random.RandomState(30 + i).randn(8, 3, 32, 32)
+                .astype(np.float32)))
+    twin.eval()
+    model = VggForCifar10(10).build(0)
+    load_torch_state_dict(model, twin.state_dict())
+    x = np.random.RandomState(2).randn(2, 3, 32, 32).astype(np.float32)
+    with torch.no_grad():
+        ref = twin(torch.from_numpy(x)).numpy()
+    _assert_prediction_parity(_predict_ours(model, x), ref)
