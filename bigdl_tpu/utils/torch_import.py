@@ -219,6 +219,7 @@ def load_torch_state_dict(model, state_dict, *, strict: bool = True):
             f"parameterized leaves, state_dict has {len(theirs)} "
             f"groups\n{_inventory(ours, theirs)}")
     for (path, mod, p_leaf, b_leaf, _proto), (prefix, group) in zip(ours, theirs):
+        group = _adapt_torch_rnn_group(mod, p_leaf, group, prefix, path)
         for leaf_name, value in group.items():
             target = b_leaf if leaf_name in _BUFFER_SUFFIXES else p_leaf
             if leaf_name not in target:
@@ -237,6 +238,49 @@ def load_torch_state_dict(model, state_dict, *, strict: bool = True):
     model.params = params
     model.buffers = buffers
     return model
+
+
+def _adapt_torch_rnn_group(mod, p_leaf, group, prefix, path):
+    """Convert a torch ``nn.RNN/LSTM/GRU`` (or ``*Cell``) parameter
+    group onto our recurrent-cell layout: torch stores
+    ``weight_ih (gH, in)`` / ``weight_hh (gH, H)`` and TWO bias vectors
+    where we store transposed ``w_ih (in, gH)`` / ``w_hh (H, gH)`` and
+    one fused ``bias`` (= bias_ih + bias_hh; both frameworks add them
+    to the same pre-activation, and the gate orders already agree:
+    i|f|g|o for LSTM, r|z|n for GRU — for GRU torch's n-gate applies
+    ``bias_hh`` inside the reset product, so a nonzero ``bias_hh_l*``
+    n-slice cannot be represented exactly and is rejected)."""
+    suffixes = {k.rsplit("_l", 1)[0] if "_l" in k else k: k
+                for k in group}
+    if not {"weight_ih", "weight_hh"} <= set(suffixes) or "w_ih" not in p_leaf:
+        return group
+    # reject multi-layer/bidirectional modules FIRST: their colliding
+    # l0/l1/_reverse suffixes would otherwise trip the bias check below
+    # with a misleading diagnostic
+    extra = set(group) - {suffixes[s] for s in
+                          ("weight_ih", "weight_hh", "bias_ih", "bias_hh")
+                          if s in suffixes}
+    if extra:
+        raise ValueError(f"{prefix}: unsupported torch RNN entries "
+                         f"{sorted(extra)} (multi-layer/bidirectional "
+                         f"torch RNN modules import layer-by-layer)")
+    H = np.shape(p_leaf["w_hh"])[0]
+    w_ih = group[suffixes["weight_ih"]].T
+    w_hh = group[suffixes["weight_hh"]].T
+    zeros = np.zeros(w_ih.shape[1], np.float32)
+    # bias=False checkpoints carry no bias entries: the exact mapping is
+    # a ZERO fused bias — leaving the model's random init would be a
+    # silent wrong-output import
+    b_ih = group.get(suffixes.get("bias_ih", ""), zeros)
+    b_hh = group.get(suffixes.get("bias_hh", ""), zeros)
+    if w_ih.shape[1] == 3 * H and np.any(b_hh[2 * H:]):
+        raise ValueError(
+            f"{prefix} -> {type(mod).__name__} at '{path}': torch GRU "
+            f"applies bias_hh's n-gate slice inside the reset "
+            f"product; a nonzero slice cannot map onto the fused "
+            f"bias layout — retrain with bias_hh=0 or import "
+            f"manually")
+    return {"w_ih": w_ih, "w_hh": w_hh, "bias": b_ih + b_hh}
 
 
 def load_torch_checkpoint(model, path: str, *, strict: bool = True):

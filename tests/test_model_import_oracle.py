@@ -528,3 +528,79 @@ def test_vgg_cifar_state_dict_import_parity():
     with torch.no_grad():
         ref = twin(torch.from_numpy(x)).numpy()
     _assert_prediction_parity(_predict_ours(model, x), ref)
+
+
+# --------------------------------------------------------------------- #
+# recurrent import (config #5's family): torch nn.LSTM/GRU modules map
+# onto our fused-gate cells (transpose + bias merge)
+# --------------------------------------------------------------------- #
+def test_recurrent_lstm_import_parity():
+    torch.manual_seed(31)
+
+    class Twin(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.lstm = torch.nn.LSTM(5, 7, batch_first=True)
+            self.fc = torch.nn.Linear(7, 3)
+
+        def forward(self, x):
+            y, _ = self.lstm(x)
+            return torch.log_softmax(self.fc(y[:, -1]), dim=-1)
+
+    twin = Twin().eval()
+    model = nn.Sequential(
+        nn.Recurrent(nn.LSTM(5, 7)),
+        nn.Select(2, -1),        # last timestep
+        nn.Linear(7, 3),
+        nn.LogSoftMax()).build(0)
+    load_torch_state_dict(model, twin.state_dict())
+    x = np.random.RandomState(3).randn(4, 6, 5).astype(np.float32)
+    with torch.no_grad():
+        ref = twin(torch.from_numpy(x)).numpy()
+    _assert_prediction_parity(_predict_ours(model, x), ref)
+
+
+def test_recurrent_gru_import_parity_and_nonzero_bias_hh_rejected():
+    torch.manual_seed(32)
+    layer = torch.nn.GRU(4, 6, batch_first=True)
+    with torch.no_grad():
+        layer.bias_hh_l0[2 * 6:].zero_()  # representable case
+    model = nn.Sequential(nn.Recurrent(nn.GRU(4, 6))).build(0)
+    load_torch_state_dict(model, {k: v for k, v in layer.state_dict().items()})
+    x = np.random.RandomState(5).randn(2, 5, 4).astype(np.float32)
+    with torch.no_grad():
+        ref, _ = layer(torch.from_numpy(x))
+    y, _ = model.apply(model.params, jnp.asarray(x), training=False)
+    np.testing.assert_allclose(np.asarray(y), ref.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    # a nonzero n-gate bias_hh slice cannot map onto the fused layout
+    torch.manual_seed(33)
+    bad = torch.nn.GRU(4, 6, batch_first=True)
+    m2 = nn.Sequential(nn.Recurrent(nn.GRU(4, 6))).build(0)
+    with pytest.raises(ValueError, match="reset"):
+        load_torch_state_dict(m2, {k: v for k, v in bad.state_dict().items()})
+
+
+def test_recurrent_lstm_biasfree_import():
+    """bias=False torch checkpoints map to an exact ZERO fused bias —
+    the random-init bias must not survive the import."""
+    torch.manual_seed(34)
+    layer = torch.nn.LSTM(5, 7, batch_first=True, bias=False)
+    model = nn.Sequential(nn.Recurrent(nn.LSTM(5, 7))).build(0)
+    load_torch_state_dict(model, dict(layer.state_dict()))
+    assert not np.any(np.asarray(model.params["0"]["cell"]["bias"]))
+    x = np.random.RandomState(6).randn(2, 5, 5).astype(np.float32)
+    with torch.no_grad():
+        ref, _ = layer(torch.from_numpy(x))
+    y, _ = model.apply(model.params, jnp.asarray(x), training=False)
+    np.testing.assert_allclose(np.asarray(y), ref.numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_recurrent_multilayer_clear_error():
+    torch.manual_seed(35)
+    layer = torch.nn.GRU(4, 6, num_layers=2, batch_first=True)
+    model = nn.Sequential(nn.Recurrent(nn.GRU(4, 6))).build(0)
+    with pytest.raises(ValueError, match="layer-by-layer"):
+        load_torch_state_dict(model, dict(layer.state_dict()),
+                              strict=False)
