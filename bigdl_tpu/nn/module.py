@@ -321,6 +321,24 @@ class Module:
         self._built()
         return caffe_loader.load(self, def_path, model_path, match_all)
 
+    def save_pytorch(self, path) -> "Module":
+        """Write this model's params/buffers as a ``torch.save``d
+        PyTorch-convention state dict.  The file round-trips through
+        ``load_pytorch``; loading it into an actual torch module needs
+        a positional key rename plus ``strict=False`` (we emit no
+        ``num_batches_tracked``), and recurrent cells export our fused
+        layout, which torch RNN modules cannot consume (see
+        utils/torch_import.export_torch_state_dict)."""
+        import torch
+        from bigdl_tpu.utils import torch_import
+        sd = torch_import.export_torch_state_dict(self)
+        # np.array: forced writable copy — jax-backed arrays are
+        # read-only views torch.from_numpy warns about and documents
+        # mutating as UB
+        torch.save({k: torch.from_numpy(np.array(v))
+                    for k, v in sd.items()}, path)
+        return self
+
     def load_pytorch(self, state_dict_or_path, strict: bool = True) -> "Module":
         """Import a PyTorch state dict (or a ``torch.save``d checkpoint
         path) into this model — the modern pretrained-import path (ref

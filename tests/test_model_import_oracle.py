@@ -604,3 +604,22 @@ def test_recurrent_multilayer_clear_error():
     with pytest.raises(ValueError, match="layer-by-layer"):
         load_torch_state_dict(model, dict(layer.state_dict()),
                               strict=False)
+
+
+def test_save_pytorch_roundtrip(tmp_path):
+    """Module.save_pytorch writes a torch.load-able state dict that
+    round-trips through load_pytorch with identical predictions."""
+    model = nn.Sequential(nn.Linear(4, 6), nn.Tanh(),
+                          nn.Linear(6, 2)).build(5)
+    p = tmp_path / "model.pth"
+    model.save_pytorch(str(p))
+    clone = nn.Sequential(nn.Linear(4, 6), nn.Tanh(),
+                          nn.Linear(6, 2)).build(8)
+    clone.load_pytorch(p)
+    x = jnp.asarray(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    y1, _ = model.apply(model.params, x, training=False)
+    y2, _ = clone.apply(clone.params, x, training=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+    # and torch itself can read it
+    sd = torch.load(str(p), weights_only=True)
+    assert sorted(sd) == ["0.bias", "0.weight", "2.bias", "2.weight"]
