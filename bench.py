@@ -492,21 +492,11 @@ def _run(batch: int) -> None:
         # upload in <=32 MB slices and assemble on device: the round-4
         # relay died at the exact moment the bench pushed its first
         # ~154 MB single-buffer transfer through the tunnel, and a
-        # bench that kills its own transport measures nothing.  One
-        # devicewise concat costs a copy; losing the backend costs the
-        # round.  (NOTES_r4.md, relay post-mortem.)
-        per_img = x_host[0].size * 2  # bf16 on the wire (host is f64)
-        chunk = max(1, (32 << 20) // per_img)
-        parts = []
-        for i in range(0, batch, chunk):
-            p = jnp.asarray(x_host[i:i + chunk], jnp.bfloat16)
-            p.block_until_ready()  # one in-flight slice at a time —
-            # device_put is async, so building the list first would
-            # enqueue every slice at once, recreating the burst
-            parts.append(p)
-        x = jnp.concatenate(parts, axis=0) if len(parts) > 1 else parts[0]
-        x.block_until_ready()
-        del parts  # don't hold a second copy of the batch in HBM
+        # bench that kills its own transport measures nothing.
+        # (NOTES_r4.md, relay post-mortem; shared helper in
+        # utils/transfer.py — serving stages batches the same way.)
+        from bigdl_tpu.utils.transfer import chunked_device_put
+        x = chunked_device_put(x_host, jnp.bfloat16)
     else:
         x = jnp.asarray(x_host, jnp.bfloat16)
     del x_host
@@ -603,8 +593,216 @@ def _run(batch: int) -> None:
         pass
 
 
+# ---------------------------------------------------------------------------
+# --serve: dynamic-batching serving latency/throughput benchmark.
+# ---------------------------------------------------------------------------
+
+#: Mixed batch sizes (all <= max batch) cycled across the workload —
+#: the compile cache only earns its hit rate if traffic is shape-diverse.
+_SERVE_MIXED_SIZES = (1, 2, 4, 3, 8, 5, 16, 7, 1, 12, 6, 2, 9, 4, 1, 8)
+
+
+def _percentiles_ms(latencies_s) -> dict:
+    import numpy as np
+    arr = np.asarray(latencies_s, dtype=np.float64) * 1000.0
+    return {"p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+            "mean_ms": round(float(arr.mean()), 3)}
+
+
+def _serve_stage_mixed_async(eng, n_requests: int, rng) -> dict:
+    """Submit a shape-diverse async workload, measure per-request
+    completion latency client-side and end-to-end throughput."""
+    import numpy as np
+    sizes = [_SERVE_MIXED_SIZES[i % len(_SERVE_MIXED_SIZES)]
+             for i in range(n_requests)]
+    done_at = [None] * n_requests
+    futures = []
+    t0 = time.perf_counter()
+    submit_at = []
+    for i, n in enumerate(sizes):
+        x = rng.randn(n, 784).astype(np.float32)
+        submit_at.append(time.perf_counter())
+        fut = eng.submit(x)
+        fut.add_done_callback(
+            lambda _f, i=i: done_at.__setitem__(i, time.perf_counter()))
+        futures.append(fut)
+    for f in futures:
+        f.result(timeout=120)
+    t1 = time.perf_counter()
+    lat = [d - s for d, s in zip(done_at, submit_at)]
+    row = _percentiles_ms(lat)
+    row["examples"] = int(sum(sizes))
+    row["throughput_eps"] = round(sum(sizes) / (t1 - t0), 2)
+    return row
+
+
+def _serve_stage_mixed_sync(eng, model, n_requests: int, rng) -> dict:
+    """Sequential predicts (each pays its own max_wait flush) plus a
+    correctness probe against the unbatched module forward."""
+    import numpy as np
+    lat, examples = [], 0
+    for i in range(n_requests):
+        n = _SERVE_MIXED_SIZES[i % len(_SERVE_MIXED_SIZES)]
+        x = rng.randn(n, 784).astype(np.float32)
+        t0 = time.perf_counter()
+        y = eng.predict(x, timeout=120)
+        lat.append(time.perf_counter() - t0)
+        examples += n
+        if i == 0:
+            ref = np.asarray(model.evaluate().forward(x))
+            err = float(np.max(np.abs(np.asarray(y) - ref)))
+    row = _percentiles_ms(lat)
+    row["examples"] = examples
+    row["throughput_eps"] = round(examples / max(sum(lat), 1e-9), 2)
+    row["max_abs_err_vs_forward"] = err
+    return row
+
+
+def _serve_stage_oversized(eng, n_requests: int, max_batch: int,
+                           rng) -> dict:
+    """Requests larger than max_batch: served alone, chunked into
+    bucket-shaped slices — throughput path, not latency path."""
+    import numpy as np
+    lat = []
+    n = max_batch * 2 + 7
+    for _ in range(n_requests):
+        x = rng.randn(n, 784).astype(np.float32)
+        t0 = time.perf_counter()
+        y = eng.predict(x, timeout=120)
+        lat.append(time.perf_counter() - t0)
+        assert y.shape[0] == n
+    row = _percentiles_ms(lat)
+    row["examples"] = n * n_requests
+    row["request_size"] = n
+    row["throughput_eps"] = round(row["examples"] / max(sum(lat), 1e-9), 2)
+    return row
+
+
+def _serve_bench(argv) -> int:
+    """Incremental, resumable serving benchmark -> BENCH_SERVE.json.
+
+    Follows the measurement-artifact contract (utils/artifacts.py):
+    rewrite after every row, ``complete: false`` until the final flush,
+    reuse only rows whose platform + full configuration match.  Runs on
+    CPU via JAX_PLATFORMS=cpu / BIGDL_TPU_BENCH_PLATFORM=cpu (both
+    honored — the sitecustomize pins the platform at interpreter start,
+    so select_platform's jax.config path is the one that works)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --serve")
+    ap.add_argument("--json", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVE.json"))
+    ap.add_argument("--requests", type=int, default=int(
+        os.environ.get("BIGDL_TPU_SERVE_REQUESTS", "160")))
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--max-wait-ms", type=float, default=3.0)
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import jax
+    import numpy as np
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.serving import ServingEngine
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    config = {"model": "lenet5", "input": [784],
+              "max_batch_size": args.max_batch,
+              "max_wait_ms": args.max_wait_ms,
+              "requests": args.requests,
+              "mixed_sizes": list(_SERVE_MIXED_SIZES),
+              "dtype": "float32"}
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "serving_mixed_batch", "platform": platform,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+    model = LeNet5(class_num=10).build(seed=1)
+    eng = ServingEngine(model, input_shape=(784,),
+                        max_batch_size=args.max_batch,
+                        max_wait_ms=args.max_wait_ms,
+                        max_queue=max(args.requests, 256))
+    try:
+        t0 = time.perf_counter()
+        compiled = eng.warmup()
+        rows.append({"stage": "warmup", "buckets": list(eng.batcher.buckets),
+                     "compiled": compiled,
+                     "warmup_s": round(time.perf_counter() - t0, 3)})
+        flush()
+
+        stages = {
+            "mixed_async": lambda: _serve_stage_mixed_async(
+                eng, args.requests, np.random.RandomState(0)),
+            "mixed_sync": lambda: _serve_stage_mixed_sync(
+                eng, model, max(8, args.requests // 8),
+                np.random.RandomState(1)),
+            "oversized": lambda: _serve_stage_oversized(
+                eng, 3, args.max_batch, np.random.RandomState(2)),
+        }
+        for name, run in stages.items():
+            if name in prev:
+                row = dict(prev[name])
+                row["reused_from_previous_run"] = True
+            else:
+                before = eng.cache.stats()
+                row = {"stage": name, **run()}
+                after = eng.cache.stats()
+                served = ((after["hits"] - before["hits"])
+                          + (after["misses"] - before["misses"]))
+                row["cache"] = {
+                    "hits": after["hits"] - before["hits"],
+                    "misses": after["misses"] - before["misses"],
+                    "hit_rate": round((after["hits"] - before["hits"])
+                                      / served, 4) if served else None}
+            rows.append(row)
+            flush()
+
+        snap = eng.metrics.snapshot(eng.cache.stats())
+        headline = next(r for r in rows if r.get("stage") == "mixed_async")
+        # a resumed run may have served nothing this process — the
+        # headline row's own (possibly reused) cache stats still hold
+        hit_rate = (headline.get("cache") or {}).get("hit_rate")
+        if hit_rate is None:
+            hit_rate = snap["compile_cache"]["hit_rate"]
+        result["summary"] = {
+            "latency_p50_ms": headline["p50_ms"],
+            "latency_p99_ms": headline["p99_ms"],
+            "throughput_eps": headline["throughput_eps"],
+            "cache_hit_rate": hit_rate,
+            "batch_occupancy": snap["batch_occupancy"],
+            "queue_wait_p99_s": snap["queue_wait"]["p99_s"],
+            "device_time_p50_s": snap["device_time"]["p50_s"],
+        }
+        result["complete"] = True
+        flush()
+        print(json.dumps({
+            "metric": "lenet5_serving_mixed_throughput_examples_per_sec",
+            "value": headline["throughput_eps"],
+            "unit": "examples/sec", "platform": platform,
+            **{k: v for k, v in result["summary"].items()
+               if k != "throughput_eps"}}), flush=True)
+        return 0
+    finally:
+        eng.close()
+
+
 if __name__ == "__main__":
-    if os.environ.get("BIGDL_TPU_BENCH_INNER"):
+    if "--serve" in sys.argv:
+        sys.exit(_serve_bench([a for a in sys.argv[1:] if a != "--serve"]))
+    elif os.environ.get("BIGDL_TPU_BENCH_INNER"):
         main()
     else:
         sys.exit(_supervise())

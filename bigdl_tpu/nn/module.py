@@ -67,6 +67,7 @@ class Module:
         self._jit_cache: dict = {}
         self._rng = None
         self._vjp_fun = None
+        self._batch_buckets: Optional[tuple] = None
 
     # ------------------------------------------------------------------ #
     # functional core                                                    #
@@ -138,14 +139,54 @@ class Module:
             self._jit_cache[key] = jax.jit(run)
         return self._jit_cache[key]
 
+    def register_batch_buckets(self, buckets: Sequence[int]) -> "Module":
+        """Pad eval-mode ``forward`` batches up to these leading-dim
+        buckets so a novel batch size within a bucket reuses the cached
+        jitted apply instead of retracing (every new leading dim is
+        otherwise a fresh trace + XLA compile).  Inference only: the
+        training path never pads — zero-filled rows would pollute
+        buffer updates (BatchNorm stats) and loss scales.  Pass None to
+        unregister.  ``serving.ServingEngine`` is the batched-traffic
+        version of the same idea."""
+        self._batch_buckets = (tuple(sorted(set(int(b) for b in buckets)))
+                               if buckets is not None else None)
+        if self._batch_buckets and self._batch_buckets[0] < 1:
+            raise ValueError("buckets must be positive ints")
+        return self
+
+    def _bucket_batch(self, x) -> Optional[int]:
+        """The bucket to pad ``x``'s leading dim to, or None for the
+        exact-shape path (training mode, no buckets registered, non-
+        array input, or batch larger than the largest bucket)."""
+        buckets = getattr(self, "_batch_buckets", None)  # pre-bucket pickles
+        if self.train or not buckets or not _is_array_like(x) \
+                or getattr(x, "ndim", 0) < 1:
+            return None
+        n = int(x.shape[0])
+        for b in buckets:
+            if b >= n:
+                return b if b != n else None  # exact hit: no pad needed
+        return None
+
     def forward(self, x: Activity) -> Activity:
         """Stateful forward (ref AbstractModule.forward:144-150, with timing)."""
         self._built()
         t0 = time.perf_counter()
         rng = self._next_rng()
-        y, new_buffers = self._jitted_apply(self.train)(self.params, self.buffers, x, rng)
-        if self.train:
-            self.buffers = new_buffers
+        bucket = self._bucket_batch(x)
+        if bucket is not None:
+            n = int(x.shape[0])
+            pad = jnp.zeros((bucket - n,) + tuple(x.shape[1:]), x.dtype)
+            xp = jnp.concatenate([jnp.asarray(x), pad], axis=0)
+            y, _ = self._jitted_apply(self.train)(self.params, self.buffers, xp, rng)
+            y = jax.tree_util.tree_map(
+                lambda a: a[:n] if (_is_array_like(a)
+                                    and getattr(a, "ndim", 0) >= 1
+                                    and a.shape[0] == bucket) else a, y)
+        else:
+            y, new_buffers = self._jitted_apply(self.train)(self.params, self.buffers, x, rng)
+            if self.train:
+                self.buffers = new_buffers
         self.output = y
         self.forward_time += time.perf_counter() - t0
         return y
@@ -358,6 +399,14 @@ class Module:
         state["_jit_cache"] = {}  # jitted callables are not picklable
         state["_vjp_fun"] = None
         return state
+
+    def serve(self, **kwargs) -> "Any":
+        """This built module as a servable endpoint — see
+        :class:`bigdl_tpu.serving.ServingEngine` for the knobs
+        (buckets, max_batch_size, max_wait_ms, backpressure)."""
+        from bigdl_tpu.serving import ServingEngine
+        self._built()
+        return ServingEngine(self, **kwargs)
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}"

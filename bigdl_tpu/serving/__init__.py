@@ -1,0 +1,35 @@
+"""bigdl_tpu.serving — dynamic-batching inference with a shape-bucketed
+compile cache.
+
+Turns any built ``nn.Module`` into a servable endpoint: requests are
+gathered by a bounded dynamic batcher, padded to power-of-two shape
+buckets (so the XLA compile cache stays small and warm), staged to the
+device in <=32 MB chunks, and executed through ahead-of-time compiled
+inference executables with hit/miss/evict accounting.  See
+``serving/engine.py`` for the full design notes.
+
+Quickstart::
+
+    from bigdl_tpu.models import LeNet5
+    from bigdl_tpu.serving import ServingEngine
+
+    model = LeNet5(class_num=10).build(seed=0)
+    with ServingEngine(model, input_shape=(784,), max_batch_size=32) as eng:
+        eng.warmup()                      # pre-trace every bucket
+        scores = eng.predict(batch)       # sync, dynamic-batched
+        fut = eng.submit(another_batch)   # async
+        print(eng.stats()["compile_cache"]["hit_rate"])
+"""
+from bigdl_tpu.serving.batcher import (DynamicBatcher, ServingClosed,
+                                       ServingQueueFull,
+                                       power_of_two_buckets)
+from bigdl_tpu.serving.compile_cache import CompileCache
+from bigdl_tpu.serving.engine import ServingEngine
+from bigdl_tpu.serving.host_transfer import HostStager
+from bigdl_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+
+__all__ = [
+    "ServingEngine", "DynamicBatcher", "CompileCache", "HostStager",
+    "ServingMetrics", "LatencyHistogram", "ServingQueueFull",
+    "ServingClosed", "power_of_two_buckets",
+]

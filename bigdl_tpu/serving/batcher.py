@@ -1,0 +1,241 @@
+"""Dynamic request batcher: bounded queue, max-batch/max-wait policy,
+power-of-two shape buckets, backpressure.
+
+BigDL's serving story (arXiv 1804.05839) is batched forward passes over
+a shared immutable model; on JAX/XLA the extra constraint is that every
+novel batch shape is a fresh compile, so the batcher rounds every
+dispatch UP to a configured bucket (powers of two by default) and the
+compile cache stays small and warm.  Policy knobs follow the classic
+serving trade-off: ``max_batch_size`` bounds device latency,
+``max_wait_ms`` bounds queueing latency (a lone request is flushed when
+its wait expires — the empty-queue timeout flush), and the bounded
+queue rejects with an error instead of growing without bound when the
+device falls behind (backpressure beats OOM).
+
+Ordering is deterministic: responses complete in submission order —
+one worker drains the FIFO queue and resolves futures sequentially.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+
+class ServingQueueFull(RuntimeError):
+    """Backpressure rejection: the bounded request queue is full."""
+
+
+class ServingClosed(RuntimeError):
+    """The batcher/engine was closed; the request was not served."""
+
+
+def power_of_two_buckets(max_batch_size: int) -> tuple:
+    """1, 2, 4, ... up to (and always including) max_batch_size."""
+    buckets = []
+    b = 1
+    while b < max_batch_size:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch_size)
+    return tuple(buckets)
+
+
+class _Request:
+    __slots__ = ("x", "n", "future", "t_enqueue")
+
+    def __init__(self, x, n: int, future: Future):
+        self.x = x
+        self.n = n
+        self.future = future
+        self.t_enqueue = time.perf_counter()
+
+
+class DynamicBatcher:
+    """Gathers requests into bucket-padded batches for ``run_batch``.
+
+    ``run_batch(x_padded) -> y_padded`` sees only bucket-shaped arrays
+    (leading dim in ``buckets``); the batcher pads with zero rows and
+    slices the per-request outputs back out.  A single request larger
+    than ``max_batch_size`` is served alone, chunked into
+    ``max_batch_size`` slices (each slice still bucket-shaped).
+    """
+
+    def __init__(self, run_batch: Callable, *,
+                 max_batch_size: int = 32,
+                 max_wait_ms: float = 5.0,
+                 max_queue: int = 256,
+                 buckets: Optional[Sequence[int]] = None,
+                 metrics=None,
+                 pool=None):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self._run = run_batch
+        self._max_batch = int(max_batch_size)
+        self._max_wait = max(0.0, float(max_wait_ms)) / 1000.0
+        self._max_queue = int(max_queue)
+        self.buckets = tuple(sorted(set(int(b) for b in (
+            buckets if buckets is not None
+            else power_of_two_buckets(max_batch_size)))))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError("buckets must be positive ints")
+        self._metrics = metrics
+        self._queue: "deque[_Request]" = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._worker_done = Future()
+        if pool is not None:
+            # reuse the shared Engine host pool (one long-running slot)
+            pool.invoke([self._loop_guard])
+        else:
+            threading.Thread(target=self._loop_guard, daemon=True,
+                             name="bigdl-tpu-batcher").start()
+
+    # ------------------------------------------------------------------ #
+    def bucket_for(self, n: int) -> int:
+        """Smallest configured bucket >= n (n must fit the largest)."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"no bucket holds {n} rows "
+                         f"(largest is {self.buckets[-1]})")
+
+    def submit(self, x, n: Optional[int] = None) -> Future:
+        """Enqueue a request of ``n`` examples (leading dim of ``x``);
+        raises ServingQueueFull when the bounded queue is full."""
+        x = np.asarray(x)
+        if n is None:
+            n = int(x.shape[0]) if x.ndim else 1
+        fut: Future = Future()
+        with self._cv:
+            if self._stop:
+                raise ServingClosed("batcher is closed")
+            if len(self._queue) >= self._max_queue:
+                if self._metrics is not None:
+                    self._metrics.record_reject()
+                raise ServingQueueFull(
+                    f"request queue full ({self._max_queue} pending); "
+                    "retry later or raise max_queue")
+            self._queue.append(_Request(x, n, fut))
+            self._cv.notify()
+        if self._metrics is not None:
+            self._metrics.record_submit()
+        return fut
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop accepting requests, drain what is queued, join the
+        worker."""
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        try:
+            self._worker_done.result(timeout=timeout)
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------ #
+    def _loop_guard(self) -> None:
+        try:
+            self._loop()
+        finally:
+            # requests that raced past the close gate still get answers
+            with self._cv:
+                leftovers = list(self._queue)
+                self._queue.clear()
+            for r in leftovers:
+                r.future.set_exception(ServingClosed("batcher closed"))
+            self._worker_done.set_result(None)
+
+    def _take_batch(self) -> Optional[list]:
+        """Block for the first request, then gather until the batch is
+        full or the oldest request's wait budget expires."""
+        with self._cv:
+            while not self._queue:
+                if self._stop:
+                    return None
+                self._cv.wait(timeout=0.05)
+            first = self._queue.popleft()
+            if first.n >= self._max_batch:
+                return [first]  # full (or oversized: served alone, chunked)
+            batch, total = [first], first.n
+            deadline = first.t_enqueue + self._max_wait
+            while total < self._max_batch:
+                if self._queue:
+                    nxt = self._queue[0]
+                    if total + nxt.n > self._max_batch:
+                        break  # never split a request across batches
+                    batch.append(self._queue.popleft())
+                    total += nxt.n
+                    continue
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0 or self._stop:
+                    break  # timeout flush (possibly a partial batch)
+                self._cv.wait(timeout=min(remaining, 0.05))
+            return batch
+
+    def _dispatch(self, xs: list, bucket: int):
+        """Pad a concatenated batch to ``bucket`` rows and run it."""
+        total = sum(int(x.shape[0]) for x in xs)
+        parts = list(xs)
+        if bucket > total:
+            parts.append(np.zeros((bucket - total,) + tuple(xs[0].shape[1:]),
+                                  xs[0].dtype))
+        joined = parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
+        return self._run(joined)
+
+    def _serve_batch(self, batch: list) -> None:
+        t_start = time.perf_counter()
+        waits = [t_start - r.t_enqueue for r in batch]
+        total = sum(r.n for r in batch)
+        try:
+            if total > self._max_batch:
+                # one oversized request: chunk through max-size slices
+                (req,) = batch
+                outs = []
+                for i in range(0, req.n, self._max_batch):
+                    piece = req.x[i:i + self._max_batch]
+                    b = self.bucket_for(int(piece.shape[0]))
+                    y = np.asarray(self._dispatch([piece], b))
+                    outs.append(y[: int(piece.shape[0])])
+                result = np.concatenate(outs, 0)
+                bucket_rows = sum(
+                    self.bucket_for(min(self._max_batch, req.n - i))
+                    for i in range(0, req.n, self._max_batch))
+                ys = [result]
+            else:
+                bucket_rows = self.bucket_for(total)
+                y = np.asarray(self._dispatch([r.x for r in batch],
+                                              bucket_rows))
+                ys, off = [], 0
+                for r in batch:
+                    ys.append(y[off:off + r.n])
+                    off += r.n
+        except Exception as e:
+            for r in batch:
+                if not r.future.cancelled():
+                    r.future.set_exception(e)
+            return
+        device_s = time.perf_counter() - t_start
+        if self._metrics is not None:
+            self._metrics.record_batch(total, bucket_rows, waits, device_s)
+        done = time.perf_counter()
+        for r, yr in zip(batch, ys):  # submission order -> response order
+            if not r.future.cancelled():
+                r.future.set_result(yr)
+            if self._metrics is not None:
+                self._metrics.record_done(done - r.t_enqueue)
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            self._serve_batch(batch)

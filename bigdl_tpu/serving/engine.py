@@ -1,0 +1,191 @@
+"""ServingEngine: a built ``nn.Module`` as a servable endpoint.
+
+The inference analog of the training-side DistriOptimizer: a frozen
+params/buffers pytree shared by every request (BigDL's serving model,
+arXiv 1804.05839 — batched forward passes over a shared immutable
+model), with
+
+- ``apply`` always under ``jit`` with ``training=False`` (no buffer
+  writes, no dropout), ahead-of-time compiled per shape bucket through
+  the explicit :class:`~bigdl_tpu.serving.compile_cache.CompileCache`;
+- a :class:`~bigdl_tpu.serving.batcher.DynamicBatcher` gathering
+  requests into bucket-padded batches (sync ``predict`` rides the same
+  queue as async ``submit`` — one dispatch path, one ordering);
+- chunked host->device staging (``host_transfer.HostStager``) so a big
+  batch never pushes an oversized single buffer through the TPU tunnel;
+- ``metrics.ServingMetrics`` splitting latency into queue wait vs
+  device time, exportable through the visualization tfevents writers.
+
+The served model's output must be a single array with a leading batch
+dim (multi-output pytree routing is a ROADMAP follow-on).
+"""
+from __future__ import annotations
+
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.serving.batcher import DynamicBatcher, power_of_two_buckets
+from bigdl_tpu.serving.compile_cache import CompileCache
+from bigdl_tpu.serving.host_transfer import HostStager
+from bigdl_tpu.serving.metrics import ServingMetrics
+from bigdl_tpu.utils.engine import Engine, select_platform
+from bigdl_tpu.utils.transfer import DEFAULT_CHUNK_BYTES
+
+
+class ServingEngine:
+    """Serve a built module.
+
+    Args:
+        module: a built ``nn.Module`` (``build()`` already called —
+            the engine freezes the params/buffers it finds).
+        input_shape: per-example input shape (no batch dim); needed by
+            ``warmup`` before the first request arrives, else inferred
+            from traffic.
+        buckets: batch-dim shape buckets; default powers of two up to
+            ``max_batch_size``.
+        max_batch_size: device batch ceiling; default ``max(buckets)``
+            or 32.
+        max_wait_ms: how long a partial batch waits for company.
+        max_queue: bounded queue depth (backpressure beyond it).
+        dtype: wire/device input dtype (default float32).
+        platform: optional jax platform pin (see
+            ``utils.engine.select_platform``).
+        donate_x: donate the input buffer to the compiled executable.
+        use_shared_pool: run the batching worker on the shared Engine
+            host pool instead of a private thread.
+    """
+
+    def __init__(self, module, *,
+                 input_shape: Optional[tuple] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_batch_size: Optional[int] = None,
+                 max_wait_ms: float = 5.0,
+                 max_queue: int = 256,
+                 dtype="float32",
+                 platform: Optional[str] = None,
+                 donate_x: bool = False,
+                 max_cache_entries: int = 16,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                 use_shared_pool: bool = True):
+        select_platform(platform)
+        import jax
+        import jax.numpy as jnp
+
+        module._built()
+        self.module = module
+        # freeze: the engine holds its own references; later training
+        # steps rebind module.params and never touch these
+        self._params = module.params
+        self._buffers = module.buffers
+        self._dtype = jnp.dtype(dtype)
+        self.input_shape = tuple(input_shape) if input_shape else None
+
+        if max_batch_size is None:
+            max_batch_size = max(buckets) if buckets else 32
+        if buckets is None:
+            buckets = power_of_two_buckets(max_batch_size)
+        if max(buckets) < max_batch_size:
+            raise ValueError(
+                f"largest bucket {max(buckets)} < max_batch_size "
+                f"{max_batch_size}: every dispatch must fit a bucket")
+
+        _rng = jax.random.PRNGKey(0)  # inert: training=False paths
+        _module = module
+
+        def _infer(params, buffers, x):
+            y, _ = _module.apply(params, x, buffers=buffers,
+                                 training=False, rng=_rng)
+            return y
+
+        self.cache = CompileCache(_infer, max_entries=max_cache_entries,
+                                  donate_x=donate_x)
+        self.stager = HostStager(self._dtype, chunk_bytes=chunk_bytes)
+        self.metrics = ServingMetrics()
+        self.batcher = DynamicBatcher(
+            self._run_batch,
+            max_batch_size=max_batch_size,
+            max_wait_ms=max_wait_ms,
+            max_queue=max_queue,
+            buckets=buckets,
+            metrics=self.metrics,
+            pool=Engine.default_or_create() if use_shared_pool else None)
+        self._closed = False
+
+    # ------------------------------------------------------------------ #
+    def _run_batch(self, x_padded: np.ndarray):
+        """Batcher callback: stage, run the bucket executable, sync."""
+        xd = self.stager.stage(x_padded)
+        y = self.cache(self._params, self._buffers, xd)
+        if not hasattr(y, "shape"):
+            raise TypeError(
+                f"ServingEngine requires a single-array model output "
+                f"with a leading batch dim; got {type(y).__name__} "
+                "(pytree outputs are a ROADMAP follow-on)")
+        return np.asarray(y)  # host pull doubles as the device sync
+
+    def _coerce(self, x, batched: bool) -> np.ndarray:
+        x = np.asarray(x, self._dtype)
+        if not batched:
+            x = x[None]
+        if self.input_shape is None and x.ndim >= 1:
+            self.input_shape = tuple(x.shape[1:])
+        return x
+
+    # ------------------------------------------------------------------ #
+    def warmup(self, input_shape: Optional[tuple] = None) -> int:
+        """Pre-compile one executable per configured bucket so the
+        first real request pays no XLA compile; returns how many were
+        compiled.  After a full warmup a bucketed workload's cache
+        hit rate is 1.0."""
+        shape = tuple(input_shape) if input_shape else self.input_shape
+        if shape is None:
+            raise ValueError("warmup needs input_shape (none configured "
+                             "and no request seen yet)")
+        self.input_shape = shape
+        shapes = [(b,) + shape for b in self.batcher.buckets]
+        return self.cache.warmup(self._params, self._buffers, shapes,
+                                 self._dtype)
+
+    def submit(self, x, *, batched: bool = True) -> Future:
+        """Async: enqueue a request (a batch by default), get a Future
+        of the output batch.  Raises ServingQueueFull on backpressure."""
+        if self._closed:
+            from bigdl_tpu.serving.batcher import ServingClosed
+            raise ServingClosed("engine is closed")
+        return self.batcher.submit(self._coerce(x, batched))
+
+    def predict(self, x, *, timeout: Optional[float] = None) -> np.ndarray:
+        """Sync: serve one batch through the same queue as submit()."""
+        return self.submit(x).result(timeout=timeout)
+
+    def predict_one(self, x, *,
+                    timeout: Optional[float] = None) -> np.ndarray:
+        """Sync single example: adds and strips the batch dim."""
+        fut = self.submit(self._coerce(x, batched=False), batched=True)
+        return fut.result(timeout=timeout)[0]
+
+    # ------------------------------------------------------------------ #
+    def stats(self) -> dict:
+        return {
+            "pending": self.batcher.pending(),
+            "buckets": list(self.batcher.buckets),
+            "compile_cache": self.cache.stats(),
+            "host_transfer": self.stager.stats(),
+            "metrics": self.metrics.snapshot(self.cache.stats()),
+        }
+
+    def export_metrics(self, summary, step: int) -> None:
+        """Write the current snapshot through a visualization Summary."""
+        self.metrics.export_to_summary(summary, step, self.cache.stats())
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        self._closed = True
+        self.batcher.close(timeout=timeout)
+
+    def __enter__(self) -> "ServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,158 @@
+"""Serving metrics: latency histograms, throughput, batch occupancy.
+
+Per-request latency is split where a serving engineer needs it split —
+queue wait (batching-policy cost) vs device time (model cost) — each a
+log-spaced histogram with percentile estimation, plus counters for
+throughput, batch occupancy (how full the padded bucket actually was)
+and the compile-cache hit rate.  ``export_to_summary`` writes the
+snapshot through the existing ``visualization`` tfevents writers, so
+serving dashboards land next to the training ones.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def _log_edges() -> List[float]:
+    # 10us .. ~100s, ~7% geometric steps: fine enough for p99 on a
+    # millisecond-scale serving path, small enough to snapshot cheaply
+    edges = []
+    v = 1e-5
+    while v < 100.0:
+        edges.append(v)
+        v *= 1.07
+    return edges
+
+
+_EDGES = _log_edges()
+
+
+class LatencyHistogram:
+    """Fixed log-bucket histogram over seconds, with percentile
+    estimation (upper bucket edge — a conservative answer for a p99
+    SLO check)."""
+
+    def __init__(self):
+        self._counts = [0] * (len(_EDGES) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        self._counts[bisect.bisect_left(_EDGES, seconds)] += 1
+        self.count += 1
+        self.sum += seconds
+        if seconds > self.max:
+            self.max = seconds
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 100]; None when empty."""
+        if not self.count:
+            return None
+        rank = max(1, int(round(self.count * p / 100.0)))
+        seen = 0
+        for i, c in enumerate(self._counts):
+            seen += c
+            if seen >= rank:
+                return _EDGES[i] if i < len(_EDGES) else self.max
+        return self.max
+
+    def snapshot(self) -> dict:
+        return {
+            "count": self.count,
+            "mean_s": (self.sum / self.count) if self.count else None,
+            "p50_s": self.percentile(50),
+            "p99_s": self.percentile(99),
+            "max_s": self.max if self.count else None,
+        }
+
+
+class ServingMetrics:
+    """One engine's counters; thread-safe (batcher worker + callers)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.queue_wait = LatencyHistogram()
+        self.device_time = LatencyHistogram()
+        self.total_latency = LatencyHistogram()
+        self.requests = 0          # accepted submissions
+        self.rejected = 0          # backpressure rejections
+        self.examples = 0          # examples completed
+        self.batches = 0           # device dispatches
+        self.batch_examples = 0    # real examples across dispatches
+        self.padded_examples = 0   # bucket slots across dispatches
+        self.started_at = time.perf_counter()
+
+    # -- recording ------------------------------------------------------ #
+    def record_submit(self) -> None:
+        with self._lock:
+            self.requests += 1
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_batch(self, n_examples: int, bucket: int,
+                     queue_waits_s, device_s: float) -> None:
+        with self._lock:
+            self.batches += 1
+            self.examples += n_examples
+            self.batch_examples += n_examples
+            self.padded_examples += bucket
+            self.device_time.observe(device_s)
+            for w in queue_waits_s:
+                self.queue_wait.observe(w)
+
+    def record_done(self, total_s: float) -> None:
+        with self._lock:
+            self.total_latency.observe(total_s)
+
+    # -- reading -------------------------------------------------------- #
+    def snapshot(self, cache_stats: Optional[dict] = None) -> dict:
+        with self._lock:
+            elapsed = time.perf_counter() - self.started_at
+            snap = {
+                "requests": self.requests,
+                "rejected": self.rejected,
+                "examples": self.examples,
+                "batches": self.batches,
+                "throughput_eps": (self.examples / elapsed) if elapsed > 0 else 0.0,
+                "batch_occupancy": (self.batch_examples / self.padded_examples)
+                                   if self.padded_examples else None,
+                "mean_batch_size": (self.batch_examples / self.batches)
+                                   if self.batches else None,
+                "queue_wait": self.queue_wait.snapshot(),
+                "device_time": self.device_time.snapshot(),
+                "total_latency": self.total_latency.snapshot(),
+            }
+        if cache_stats is not None:
+            snap["compile_cache"] = dict(cache_stats)
+        return snap
+
+    def export_to_summary(self, summary, step: int,
+                          cache_stats: Optional[dict] = None) -> None:
+        """Write the scalar snapshot through a ``visualization.Summary``
+        (tfevents) writer under ``Serving/*`` tags."""
+        snap = self.snapshot(cache_stats)
+        flat: Dict[str, Optional[float]] = {
+            "Serving/Requests": snap["requests"],
+            "Serving/Rejected": snap["rejected"],
+            "Serving/ThroughputEPS": snap["throughput_eps"],
+            "Serving/BatchOccupancy": snap["batch_occupancy"],
+            "Serving/QueueWaitP50": snap["queue_wait"]["p50_s"],
+            "Serving/QueueWaitP99": snap["queue_wait"]["p99_s"],
+            "Serving/DeviceTimeP50": snap["device_time"]["p50_s"],
+            "Serving/DeviceTimeP99": snap["device_time"]["p99_s"],
+            "Serving/LatencyP50": snap["total_latency"]["p50_s"],
+            "Serving/LatencyP99": snap["total_latency"]["p99_s"],
+        }
+        cache = snap.get("compile_cache") or {}
+        if cache.get("hit_rate") is not None:
+            flat["Serving/CacheHitRate"] = cache["hit_rate"]
+        for tag, value in flat.items():
+            if value is not None:
+                summary.add_scalar(tag, float(value), step)
+        summary.flush()

@@ -174,6 +174,38 @@ _pin_active = False
 _pinned_prior_platforms = None
 
 
+def select_platform(platform: Optional[str] = None, *,
+                    honor_jax_platforms: bool = False) -> Optional[str]:
+    """Pin the JAX platform before the first backend touch and return
+    the effective choice (or None for "leave it to jax").
+
+    Resolution order: explicit arg > ``BIGDL_TPU_PLATFORM`` > (with
+    ``honor_jax_platforms``) ``JAX_PLATFORMS``.  The env's
+    sitecustomize imports jax at interpreter start with JAX_PLATFORMS
+    already consumed, so a plain env var is IGNORED for CLIs —
+    ``jax.config.update`` before first backend use is the supported
+    escape hatch, and this helper is its single home (Engine.init,
+    bench.py --serve and serving all route through it).  JAX_PLATFORMS
+    is opt-in because library callers (Engine.init under tests) must
+    not let a sitecustomize-exported accelerator value override an
+    already-pinned cpu platform.  Once a backend is initialized the
+    pin is too late; the attempt is swallowed and the live platform
+    wins.
+    """
+    import jax
+
+    platform = (platform
+                or os.environ.get("BIGDL_TPU_PLATFORM")
+                or (os.environ.get("JAX_PLATFORMS")
+                    if honor_jax_platforms else None))
+    if platform and jax.config.jax_platforms != platform:
+        try:
+            jax.config.update("jax_platforms", platform)
+        except RuntimeError:
+            pass  # backend already initialized; too late to switch
+    return platform or None
+
+
 def release_virtual_devices() -> None:
     """Undo ``ensure_virtual_devices``' process-global cpu-platform pin:
     restore the prior ``jax_platforms`` setting and clear the cached
@@ -214,12 +246,7 @@ class Engine:
         """
         import jax
 
-        platform = platform or os.environ.get("BIGDL_TPU_PLATFORM")
-        if platform and jax.config.jax_platforms != platform:
-            try:
-                jax.config.update("jax_platforms", platform)
-            except RuntimeError:
-                pass  # backend already initialized; too late to switch
+        select_platform(platform)
 
         with _state.lock:
             if node_number is None:
@@ -252,6 +279,21 @@ class Engine:
     def default() -> ThreadPool:
         Engine._require_init()
         return _state.default_pool  # type: ignore[return-value]
+
+    @staticmethod
+    def default_or_create(size: Optional[int] = None) -> ThreadPool:
+        """The shared host pool, created lazily if Engine.init has not
+        run yet.  Serving and other host-side consumers reuse ONE pool
+        per process instead of each spinning a private executor; a
+        later Engine.init adopts the same pool (init only fills the
+        slot when empty)."""
+        with _state.lock:
+            if _state.default_pool is None:
+                host_threads = size or int(os.environ.get(
+                    "BIGDL_TPU_DEFAULT_POOL_SIZE",
+                    str(max(os.cpu_count() or 4, 4))))
+                _state.default_pool = ThreadPool(host_threads)
+            return _state.default_pool
 
     @staticmethod
     def model() -> ThreadPool:

@@ -1,0 +1,68 @@
+"""Chunked host->device staging — the shared transfer discipline.
+
+The tunneled TPU backend dies on oversized single-buffer transfers (the
+round-4 relay was lost to one ~154 MB host->device push, NOTES_r4.md);
+every tool that stages real batches must therefore slice the upload
+along the leading dim into <=32 MB pieces with exactly one slice in
+flight at a time, then assemble on device.  bench.py carried this
+inline; serving needs it too, so the pattern lives here once.
+
+One devicewise concat costs a copy; losing the backend costs the round.
+"""
+from __future__ import annotations
+
+#: Conservative per-transfer ceiling; the relay died somewhere between
+#: 32 MB (fine in round 4) and ~154 MB (fatal).
+DEFAULT_CHUNK_BYTES = 32 << 20
+
+
+def chunked_device_put(x_host, dtype=None, *,
+                       chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+                       device=None):
+    """Stage ``x_host`` onto the device in <= ``chunk_bytes`` slices
+    along the leading dim, one in flight at a time, and return the
+    assembled (blocked-until-ready) device array.
+
+    ``dtype`` is the wire/device dtype (chunk sizing uses it — a f64
+    host batch uploaded as bf16 moves a quarter of the bytes).  Arrays
+    that fit in one chunk take the single device_put fast path; 0-d
+    arrays always do.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    x_host = np.asarray(x_host)
+    target = jnp.dtype(dtype) if dtype is not None else x_host.dtype
+
+    def _put(a):
+        arr = jnp.asarray(a, target)
+        if device is not None:
+            import jax
+            arr = jax.device_put(arr, device)
+        return arr
+
+    if x_host.ndim == 0 or x_host.size == 0:
+        out = _put(x_host)
+        out.block_until_ready()
+        return out
+
+    per_row = max(1, int(x_host[0:1].size) * jnp.dtype(target).itemsize)
+    rows = max(1, int(chunk_bytes) // per_row)
+    n = x_host.shape[0]
+    if rows >= n:
+        out = _put(x_host)
+        out.block_until_ready()
+        return out
+
+    parts = []
+    for i in range(0, n, rows):
+        p = _put(x_host[i:i + rows])
+        # one in-flight slice at a time — device_put is async, so
+        # building the list without blocking would enqueue every slice
+        # at once, recreating the oversized burst
+        p.block_until_ready()
+        parts.append(p)
+    out = jnp.concatenate(parts, axis=0)
+    out.block_until_ready()
+    del parts  # don't hold a second copy of the batch alive
+    return out

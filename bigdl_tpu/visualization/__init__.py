@@ -5,12 +5,13 @@ from .crc import crc32c, masked_crc32c
 from .proto import Event, HistogramProto, SummaryValue, decode_event
 from .record import RecordWriter, read_records
 from .reader import list_files, list_tags, read_scalar
-from .summary import Summary, TrainSummary, ValidationSummary, histogram, scalar
+from .summary import (Summary, ServingSummary, TrainSummary,
+                      ValidationSummary, histogram, scalar)
 from .writer import EventWriter, FileWriter
 
 __all__ = [
     "crc32c", "masked_crc32c", "Event", "HistogramProto", "SummaryValue",
     "decode_event", "RecordWriter", "read_records", "list_files",
-    "list_tags", "read_scalar", "Summary", "TrainSummary",
+    "list_tags", "read_scalar", "Summary", "ServingSummary", "TrainSummary",
     "ValidationSummary", "histogram", "scalar", "EventWriter", "FileWriter",
 ]

@@ -123,3 +123,14 @@ class TrainSummary(Summary):
 class ValidationSummary(Summary):
     def __init__(self, log_dir: str, app_name: str):
         super().__init__(log_dir, app_name, "validation")
+
+
+class ServingSummary(Summary):
+    """Inference-side logger: ``serving.ServingMetrics.export_to_summary``
+    (or ``ServingEngine.export_metrics``) writes latency percentiles,
+    throughput, batch occupancy and compile-cache hit rate here, so
+    serving dashboards land in ``<logdir>/<app>/serving`` next to the
+    train/validation folders."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "serving")
