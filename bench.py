@@ -503,10 +503,14 @@ def _run(batch: int) -> None:
     y = jnp.asarray(np.random.RandomState(1).randint(1, 1001, size=batch)
                     .astype(np.float32))
 
+    from bigdl_tpu.obs import get_tracer
+    tracer = get_tracer()
+
     # compile + warmup (first TPU compile is slow; subsequent cached)
-    for _ in range(3):
-        params, buffers, opt_state, loss = step(params, buffers, opt_state, x, y, rng)
-    _ = float(loss)  # hard sync
+    with tracer.span("bench/warmup", cat="bench", batch=batch):
+        for _ in range(3):
+            params, buffers, opt_state, loss = step(params, buffers, opt_state, x, y, rng)
+        _ = float(loss)  # hard sync
 
     # step flops per XLA's cost model on the LOWERED (pre-compile) module
     # — compiling again here would redo the full ResNet-50 compile and
@@ -524,9 +528,12 @@ def _run(batch: int) -> None:
 
     iters = int(os.environ.get("BIGDL_TPU_BENCH_ITERS", "20"))
     t0 = time.perf_counter()
-    for _ in range(iters):
-        params, buffers, opt_state, loss = step(params, buffers, opt_state, x, y, rng)
-    _ = float(loss)  # hard sync: loss depends on the whole step chain
+    for i in range(iters):
+        with tracer.span("bench/step", cat="bench", iteration=i,
+                         batch=batch):
+            params, buffers, opt_state, loss = step(params, buffers, opt_state, x, y, rng)
+    with tracer.span("bench/sync", cat="bench"):
+        _ = float(loss)  # hard sync: loss depends on the whole step chain
     dt = time.perf_counter() - t0
 
     imgs_per_sec = batch * iters * scan_k / dt
@@ -591,6 +598,16 @@ def _run(batch: int) -> None:
                 f.write(line + "\n")
     except OSError:
         pass
+    if tracer.enabled:
+        # --trace (or BIGDL_TPU_TRACE=1): Chrome-trace artifact next to
+        # the BENCH_* files — load in Perfetto / chrome://tracing
+        trace_path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "TRACE_BENCH.json")
+        try:
+            tracer.export_chrome(trace_path)
+            print(f"bench: trace written to {trace_path}", file=sys.stderr)
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -697,7 +714,13 @@ def _serve_bench(argv) -> int:
         os.environ.get("BIGDL_TPU_SERVE_REQUESTS", "160")))
     ap.add_argument("--max-batch", type=int, default=32)
     ap.add_argument("--max-wait-ms", type=float, default=3.0)
+    ap.add_argument("--trace", action="store_true",
+                    help="record obs spans; write TRACE_SERVE.json")
     args = ap.parse_args(argv)
+
+    from bigdl_tpu.obs import get_tracer
+    if args.trace:
+        get_tracer().enable()
 
     from bigdl_tpu.utils.engine import select_platform
     select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
@@ -797,9 +820,26 @@ def _serve_bench(argv) -> int:
         return 0
     finally:
         eng.close()
+        tr = get_tracer()
+        if tr.enabled:
+            trace_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "TRACE_SERVE.json")
+            try:
+                tr.export_chrome(trace_path)
+                print(f"bench: trace written to {trace_path}",
+                      file=sys.stderr)
+            except OSError:
+                pass
 
 
 if __name__ == "__main__":
+    if "--trace" in sys.argv and "--serve" not in sys.argv:
+        # training bench: the measurement runs in the supervisor's inner
+        # subprocess, which inherits env but not argv — hand the flag
+        # down as BIGDL_TPU_TRACE and strip it here
+        sys.argv = [a for a in sys.argv if a != "--trace"]
+        os.environ["BIGDL_TPU_TRACE"] = "1"
     if "--serve" in sys.argv:
         sys.exit(_serve_bench([a for a in sys.argv[1:] if a != "--serve"]))
     elif os.environ.get("BIGDL_TPU_BENCH_INNER"):

@@ -1,0 +1,45 @@
+"""bigdl_tpu.obs — unified observability: tracing, metrics, watchdog.
+
+Three pieces, one spine:
+
+- :mod:`~bigdl_tpu.obs.tracer` — thread-safe span API (context manager
+  + decorator) over a ring buffer, exported as Chrome trace-event JSON
+  (Perfetto-loadable) or a structured JSONL log.  Enabled via
+  ``BIGDL_TPU_TRACE=1``; near-zero overhead when off.
+- :mod:`~bigdl_tpu.obs.registry` — process-wide MetricRegistry of
+  counters/gauges/histograms; ``optim.Metrics`` and
+  ``serving.ServingMetrics`` publish into it, and one
+  ``export_to_summary`` path writes everything through the
+  ``visualization`` tfevents writers.
+- :mod:`~bigdl_tpu.obs.watchdog` — StallWatchdog: rolling-median step
+  cadence; a hung step captures ``Engine.diagnose_tpu()`` + all-thread
+  stacks into the trace before the process looks merely "slow".
+
+Quickstart::
+
+    import os; os.environ["BIGDL_TPU_TRACE"] = "1"   # before import
+    from bigdl_tpu import obs
+
+    tr = obs.get_tracer()
+    with tr.span("my_phase", cat="app", rows=1024):
+        ...
+    tr.export_chrome("TRACE_app.json")               # open in Perfetto
+
+    reg = obs.get_registry()
+    reg.counter("app/requests").add(1)
+    print(reg.snapshot())
+"""
+from bigdl_tpu.obs.registry import (Counter, FnGauge, Gauge, Histogram,
+                                    MetricRegistry, get_registry)
+from bigdl_tpu.obs.tracer import Tracer, get_tracer
+from bigdl_tpu.obs.watchdog import (StallWatchdog, env_watchdog_enabled,
+                                    env_watchdog_kwargs, shared_watchdog,
+                                    thread_stacks)
+
+__all__ = [
+    "Tracer", "get_tracer",
+    "Counter", "Gauge", "FnGauge", "Histogram", "MetricRegistry",
+    "get_registry",
+    "StallWatchdog", "env_watchdog_enabled", "env_watchdog_kwargs",
+    "shared_watchdog", "thread_stacks",
+]

@@ -1,0 +1,223 @@
+"""Span tracing: one trace spine for training steps and serving requests.
+
+The reference's observability is per-phase wall-clock counters summed on
+the Spark driver (optim/Metrics.scala); a counter tells you the *mean*
+cost of a phase, never which iteration or which request was slow.  This
+module is the missing timeline: a thread-safe span API whose events
+export as Chrome trace-event JSON (loadable in Perfetto / chrome://
+tracing) and as a structured JSONL log.
+
+Design constraints, in order:
+
+1. near-zero overhead when disabled — every instrumented hot path
+   (batcher dispatch, per-chunk uploads, the training loop) calls
+   ``span()`` unconditionally, so the disabled path must be one
+   attribute check returning a shared no-op context manager;
+2. thread-safe and allocation-bounded — events land in a ring buffer
+   (``collections.deque`` with ``maxlen``), so a week-long serving
+   process can keep tracing without growing;
+3. retroactive spans — the batcher learns a request's queue wait only
+   at dispatch time, so ``add_complete`` accepts an explicit start
+   timestamp instead of requiring a context manager around the wait.
+
+Toggled by the ``BIGDL_TPU_TRACE`` env var (read at import for the
+process-wide tracer; ``enable()``/``disable()`` flip it at runtime).
+Timestamps are ``time.perf_counter`` microseconds relative to the
+tracer's epoch — monotonic, immune to NTP steps, and exactly what the
+Chrome ``ts``/``dur`` fields want.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from functools import wraps
+from typing import Optional
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("BIGDL_TPU_TRACE", "0").lower() in ("1", "true", "on")
+
+
+class _NullSpan:
+    """Shared no-op context manager for the disabled path."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span; records a Chrome 'X' (complete) event on exit."""
+    __slots__ = ("_tracer", "name", "cat", "args", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self._t0 = time.perf_counter()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        if exc_type is not None:
+            self.args = dict(self.args)
+            self.args["error"] = f"{exc_type.__name__}: {exc}"
+        self._tracer.add_complete(self.name, self._t0, t1 - self._t0,
+                                  cat=self.cat, args=self.args)
+        return False
+
+
+class Tracer:
+    """Ring-buffered trace-event collector.
+
+    One process normally uses the module-level tracer (``get_tracer()``);
+    private instances exist for tests and for tools that want an
+    isolated buffer.
+    """
+
+    def __init__(self, capacity: int = 65536,
+                 enabled: Optional[bool] = None):
+        self.enabled = _env_enabled() if enabled is None else bool(enabled)
+        self._events: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        # perf_counter epoch; the unix pair stamps exports with wall time
+        self._epoch_perf = time.perf_counter()
+        self._epoch_unix = time.time()
+        self._pid = os.getpid()
+
+    # -- control -------------------------------------------------------- #
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    # -- recording ------------------------------------------------------ #
+    def span(self, name: str, cat: str = "obs", **args):
+        """Context manager timing a section.  Disabled: a shared no-op."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    def traced(self, name: Optional[str] = None, cat: str = "obs"):
+        """Decorator form of ``span`` (span name defaults to the
+        function's qualified name)."""
+        def deco(fn):
+            label = name or fn.__qualname__
+
+            @wraps(fn)
+            def wrapper(*a, **kw):
+                if not self.enabled:
+                    return fn(*a, **kw)
+                with self.span(label, cat=cat):
+                    return fn(*a, **kw)
+            return wrapper
+        return deco
+
+    def _ts_us(self, t_perf: float) -> float:
+        return (t_perf - self._epoch_perf) * 1e6
+
+    def add_complete(self, name: str, t0_perf: float, dur_s: float,
+                     cat: str = "obs", args: Optional[dict] = None,
+                     tid: Optional[int] = None) -> None:
+        """Record a finished span retroactively (``t0_perf`` from
+        ``time.perf_counter``) — how the batcher reports a request's
+        queue wait it only knows at dispatch time."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "cat": cat, "ph": "X",
+              "ts": self._ts_us(t0_perf), "dur": max(dur_s, 0.0) * 1e6,
+              "pid": self._pid,
+              "tid": tid if tid is not None else threading.get_ident()}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def instant(self, name: str, cat: str = "obs", **args) -> None:
+        """Point-in-time event (Chrome ph='i', thread scope)."""
+        if not self.enabled:
+            return
+        ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
+              "ts": self._ts_us(time.perf_counter()),
+              "pid": self._pid, "tid": threading.get_ident()}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    # -- reading / export ---------------------------------------------- #
+    def events(self) -> list:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def _thread_metadata(self, events: list) -> list:
+        """Chrome 'M' thread_name rows so Perfetto shows thread names
+        instead of bare idents."""
+        names = {t.ident: t.name for t in threading.enumerate()
+                 if t.ident is not None}
+        rows = []
+        for tid in sorted({e["tid"] for e in events}):
+            rows.append({"name": "thread_name", "ph": "M", "pid": self._pid,
+                         "tid": tid,
+                         "args": {"name": names.get(tid, f"thread-{tid}")}})
+        return rows
+
+    def export_chrome(self, path: Optional[str] = None) -> dict:
+        """The buffered events as a Chrome trace-event document
+        (``{"traceEvents": [...]}``); written to ``path`` when given.
+        Loadable as-is in Perfetto / chrome://tracing."""
+        events = self.events()
+        doc = {
+            "traceEvents": self._thread_metadata(events) + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "bigdl_tpu.obs",
+                "epoch_unix": self._epoch_unix,
+            },
+        }
+        if path:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        return doc
+
+    def export_jsonl(self, path: str) -> int:
+        """Structured event log: one JSON object per line (the grep/jq
+        side of the same buffer); returns the row count."""
+        events = self.events()
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        os.replace(tmp, path)
+        return len(events)
+
+
+#: process-wide tracer — instrumented modules bind this once at import
+_GLOBAL = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _GLOBAL

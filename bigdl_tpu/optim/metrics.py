@@ -6,18 +6,63 @@ process, and ``aggregate()`` plays the Spark-accumulator role in a
 multi-host job: every process contributes its counters and receives the
 cross-process mean (a host-side allgather over DCN — cheap, called at
 summary points only, and collective: every process must call it).
+
+Storage is :class:`bigdl_tpu.obs.registry.Counter` objects, so an
+optimizer's phase counters can be published into the process-wide
+``obs`` registry (``publish_to``) and ride the same snapshot/tfevents
+export path as the serving metrics — the reference's "driver
+accumulator" view, without a driver.
 """
 from __future__ import annotations
 
 import threading
 
+from bigdl_tpu.obs.registry import Counter, MetricRegistry
+
 
 class Metrics:
     def __init__(self):
-        self._values: dict[str, float] = {}
-        self._counts: dict[str, int] = {}
+        self._counters: dict[str, Counter] = {}
         self._lock = threading.Lock()
+        self._published: list[tuple[MetricRegistry, str]] = []
 
+    # -- registry wiring ------------------------------------------------ #
+    def publish_to(self, registry: MetricRegistry,
+                   prefix: str = "train/") -> "Metrics":
+        """Expose every counter (current and future) in ``registry``
+        under ``prefix`` — live objects, not copies; latest publisher
+        wins the names (replace semantics)."""
+        with self._lock:
+            self._published.append((registry, prefix))
+            for name, c in self._counters.items():
+                registry.register(prefix + name, c, replace=True)
+        return self
+
+    def _counter(self, name: str, unit: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            c = Counter(unit=unit)
+            self._counters[name] = c
+            for registry, prefix in self._published:
+                registry.register(prefix + name, c, replace=True)
+        return c
+
+    # -- recording ------------------------------------------------------ #
+    def set(self, name: str, value: float, parallel: int = 1,
+            unit: str = "s") -> None:
+        with self._lock:
+            self._counter(name, unit).set(float(value), parallel)
+
+    def add(self, name: str, value: float, unit: str = "s") -> None:
+        with self._lock:
+            self._counter(name, unit).add(float(value))
+
+    def get(self, name: str) -> tuple[float, int]:
+        with self._lock:
+            c = self._counters.get(name)
+            return c.get() if c is not None else (0.0, 1)
+
+    # -- aggregation / reporting ---------------------------------------- #
     def aggregate(self) -> "Metrics":
         """Cross-process mean of every counter (ref Metrics.scala:24-112:
         Spark accumulators summed on the driver; here each process gets
@@ -29,39 +74,36 @@ class Metrics:
         import numpy as np
         from jax.experimental import multihost_utils
         with self._lock:
-            names = sorted(self._values)
-            local = np.array([self._values[n] for n in names], np.float64)
+            names = sorted(self._counters)
+            local = np.array([self._counters[n].value for n in names],
+                             np.float64)
         gathered = np.asarray(multihost_utils.process_allgather(local))
         mean = gathered.mean(axis=0) if gathered.ndim > 1 else gathered
         out = Metrics()
         with self._lock:
             for i, n in enumerate(names):
-                out._values[n] = float(mean[i])
-                out._counts[n] = self._counts.get(n, 1)
+                src = self._counters[n]
+                out.set(n, float(mean[i]), parallel=src.n, unit=src.unit)
         return out
 
-    def set(self, name: str, value: float, parallel: int = 1) -> None:
-        with self._lock:
-            self._values[name] = float(value)
-            self._counts[name] = parallel
-
-    def add(self, name: str, value: float) -> None:
-        with self._lock:
-            self._values[name] = self._values.get(name, 0.0) + float(value)
-            self._counts.setdefault(name, 1)
-
-    def get(self, name: str) -> tuple[float, int]:
-        with self._lock:
-            return self._values.get(name, 0.0), self._counts.get(name, 1)
-
     def summary(self, unit_scale: float = 1.0) -> str:
-        """Summary in seconds.  Values here are recorded in seconds already
-        (the reference stores nanoseconds and divides by 1e9,
-        optim/Metrics.scala:96); pass unit_scale for other units."""
+        """Per-phase means.  Time counters (``unit="s"``, the default —
+        values recorded in seconds; the reference stores nanoseconds and
+        divides by 1e9, optim/Metrics.scala:96) are scaled by
+        ``unit_scale`` and labeled `` s``; counters recorded with any
+        other unit print their raw value — a batch count must not be
+        stamped as seconds — with their own unit suffix when one was
+        given."""
         with self._lock:
             lines = ["========== Metrics Summary =========="]
-            for name, v in self._values.items():
-                n = self._counts.get(name, 1)
-                lines.append(f"{name} : {v / unit_scale / max(n, 1)} s")
+            for name, c in self._counters.items():
+                v, n = c.get()
+                mean = v / max(n, 1)
+                if c.unit == "s":
+                    lines.append(f"{name} : {mean / unit_scale} s")
+                elif c.unit:
+                    lines.append(f"{name} : {mean} {c.unit}")
+                else:
+                    lines.append(f"{name} : {mean}")
             lines.append("=====================================")
             return "\n".join(lines)

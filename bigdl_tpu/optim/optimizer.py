@@ -113,7 +113,10 @@ class Optimizer:
         self.train_summary = None
         self.validation_summary = None
         self.state: dict = {}
-        self.metrics = Metrics()
+        # phase counters, published live into the process-wide obs
+        # registry (one snapshot path with the serving metrics)
+        from bigdl_tpu.obs import get_registry
+        self.metrics = Metrics().publish_to(get_registry())
         self.compute_dtype = None  # e.g. jnp.bfloat16; None = full f32
         self.grad_accum = 1  # micro-batches per step (set_gradient_accumulation)
 

@@ -42,8 +42,17 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:  # newer jax: top-level alias, replication check spelled check_vma
+    from jax import shard_map
+    _SHARD_MAP_NO_CHECK = {"check_vma": False}
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+    _SHARD_MAP_NO_CHECK = {"check_rep": False}
+
 from bigdl_tpu.dataset.dataset import AbstractDataSet
 from bigdl_tpu.nn.module import Criterion, Module
+from bigdl_tpu.obs import (env_watchdog_enabled, env_watchdog_kwargs,
+                           get_tracer, shared_watchdog)
 from bigdl_tpu.optim.optimizer import (Optimizer, Validator,
                                        accumulated_value_and_grad)
 from bigdl_tpu.optim.validation import ValidationMethod
@@ -166,12 +175,12 @@ class DistriOptimizer(Optimizer):
         buf_specs = jax.tree_util.tree_map(lambda b: repl, self.model.buffers)
         batch_spec = P(DATA_AXIS)
 
-        mapped = jax.shard_map(
+        mapped = shard_map(
             step, mesh=self.mesh,
             in_specs=(shard, opt_specs, buf_specs, batch_spec, batch_spec,
                       repl, repl),
             out_specs=(shard, opt_specs, buf_specs, repl),
-            check_vma=False,
+            **_SHARD_MAP_NO_CHECK,
         )
         return jax.jit(mapped, donate_argnums=(0, 1))
 
@@ -284,16 +293,29 @@ class DistriOptimizer(Optimizer):
         # collective order is untouched.
         overlap = os.environ.get("BIGDL_TPU_PREFETCH_OVERLAP", "1") == "1"
 
+        tracer = get_tracer()
+
         def fetch_and_place():
-            batch = next(data_iter)
+            with tracer.span("train/fetch", cat="train"):
+                batch = next(data_iter)
             t_shard = time.perf_counter()
-            data = _shard_batch(self.mesh, np.asarray(batch.data))
-            labels = _shard_batch(self.mesh, np.asarray(batch.labels))
+            with tracer.span("train/h2d", cat="train",
+                             rows=int(np.asarray(batch.data).shape[0])):
+                data = _shard_batch(self.mesh, np.asarray(batch.data))
+                labels = _shard_batch(self.mesh, np.asarray(batch.labels))
             # phase metric: host->device batch placement (the data-side
             # analog of the reference's per-phase Metrics,
             # optim/DistriOptimizer.scala:115-119)
             self.metrics.add("shard data time", time.perf_counter() - t_shard)
             return batch, data, labels
+
+        # step-cadence stall detection: a wedged backend mid-step looks
+        # merely "slow" from outside (NOTES_r4.md); the watchdog names
+        # it — diagnose_tpu + thread stacks into the trace/log
+        watchdog = None
+        if env_watchdog_enabled():
+            watchdog = shared_watchdog("train_step")
+            watchdog.reset(**env_watchdog_kwargs())
 
         next_ready = None
         accum_checked = False
@@ -343,6 +365,8 @@ class DistriOptimizer(Optimizer):
                     sds, (w_shards, opt_state, buffers, data, labels, sub,
                           jnp.asarray(self.state["epoch"])))
             t0 = time.perf_counter()
+            if watchdog is not None:
+                watchdog.step_started()
             w_shards, opt_state, buffers, loss = step_fn(
                 w_shards, opt_state, buffers, data, labels, sub,
                 self.state["epoch"])
@@ -356,7 +380,16 @@ class DistriOptimizer(Optimizer):
                 # and places a batch it will throw away
                 next_ready = fetch_and_place()
             loss_val = float(loss)
+            if watchdog is not None:
+                watchdog.step_finished()
             dt = time.perf_counter() - t0
+            # retroactive span: dispatch + (hidden) prefetch + loss sync
+            # — the device-bound section the watchdog brackets; nested
+            # train/fetch|h2d spans from the prefetch land inside it
+            tracer.add_complete("train/step", t0, dt, cat="train",
+                                args={"iteration": self.state["neval"],
+                                      "epoch": self.state["epoch"],
+                                      "loss": loss_val})
             global_bs = local_bs * jax.process_count()
             records_this_epoch += global_bs
             self.metrics.add("computing time", dt)
@@ -389,9 +422,12 @@ class DistriOptimizer(Optimizer):
                     return
                 published = True
                 t_pub = time.perf_counter()
-                self.model.params = arp.to_pytree(_fetch_to_host(w_shards))
-                self.model.buffers = buffers
-                self.optim_method._state = _fetch_tree_to_host(opt_state)
+                with tracer.span("train/publish", cat="train",
+                                 iteration=self.state["neval"]):
+                    self.model.params = arp.to_pytree(
+                        _fetch_to_host(w_shards))
+                    self.model.buffers = buffers
+                    self.optim_method._state = _fetch_tree_to_host(opt_state)
                 self.metrics.add("publish time",
                                  time.perf_counter() - t_pub)
 
@@ -420,9 +456,13 @@ class DistriOptimizer(Optimizer):
                 # the post-loop host fetch does that work once
                 publish()
                 if do_val:
-                    self._run_validation()
+                    with tracer.span("train/validate", cat="train",
+                                     iteration=self.state["neval"]):
+                        self._run_validation()
                 if do_ckpt or preempt_ckpt:
-                    self._checkpoint()
+                    with tracer.span("train/checkpoint", cat="train",
+                                     iteration=self.state["neval"]):
+                        self._checkpoint()
             if preempted:
                 log.warning("stopping on preemption at iteration %d",
                             self.state["neval"] - 1)
@@ -433,12 +473,15 @@ class DistriOptimizer(Optimizer):
         # aggregated on the driver) — safe as a collective here: every
         # process exits the loop in lockstep (preemption is consensus'd)
         log.info("phase breakdown: %s", self.metrics.aggregate().summary())
-        self.model.params = arp.to_pytree(_fetch_to_host(w_shards))
-        self.model.buffers = buffers
-        # publish the final optimizer state too — without this, a run that
-        # never checkpointed leaves _state at its pre-loop value and a
-        # later save/resume would rewind the moments and LR schedule
-        self.optim_method._state = _fetch_tree_to_host(opt_state)
+        with tracer.span("train/publish", cat="train", final=True,
+                         iteration=self.state["neval"] - 1):
+            self.model.params = arp.to_pytree(_fetch_to_host(w_shards))
+            self.model.buffers = buffers
+            # publish the final optimizer state too — without this, a run
+            # that never checkpointed leaves _state at its pre-loop value
+            # and a later save/resume would rewind the moments and LR
+            # schedule
+            self.optim_method._state = _fetch_tree_to_host(opt_state)
         return self.model
 
     def collective_footprint(self) -> dict:

@@ -25,6 +25,10 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu.obs.tracer import get_tracer
+
+_tracer = get_tracer()
+
 
 class ServingQueueFull(RuntimeError):
     """Backpressure rejection: the bounded request queue is full."""
@@ -121,9 +125,12 @@ class DynamicBatcher:
                     f"request queue full ({self._max_queue} pending); "
                     "retry later or raise max_queue")
             self._queue.append(_Request(x, n, fut))
+            depth = len(self._queue)
             self._cv.notify()
         if self._metrics is not None:
             self._metrics.record_submit()
+        _tracer.instant("serve/enqueue", cat="serve", n=n,
+                        queue_depth=depth)
         return fut
 
     def pending(self) -> int:
@@ -184,17 +191,27 @@ class DynamicBatcher:
     def _dispatch(self, xs: list, bucket: int):
         """Pad a concatenated batch to ``bucket`` rows and run it."""
         total = sum(int(x.shape[0]) for x in xs)
-        parts = list(xs)
-        if bucket > total:
-            parts.append(np.zeros((bucket - total,) + tuple(xs[0].shape[1:]),
-                                  xs[0].dtype))
-        joined = parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
-        return self._run(joined)
+        with _tracer.span("serve/assemble", cat="serve",
+                          requests=len(xs), rows=total, bucket=bucket):
+            parts = list(xs)
+            if bucket > total:
+                parts.append(np.zeros(
+                    (bucket - total,) + tuple(xs[0].shape[1:]),
+                    xs[0].dtype))
+            joined = parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
+        with _tracer.span("serve/device", cat="serve", bucket=bucket):
+            return self._run(joined)
 
     def _serve_batch(self, batch: list) -> None:
         t_start = time.perf_counter()
         waits = [t_start - r.t_enqueue for r in batch]
         total = sum(r.n for r in batch)
+        if _tracer.enabled:
+            # queue-wait spans are known only now — record retroactively
+            # from each request's enqueue timestamp
+            for r, w in zip(batch, waits):
+                _tracer.add_complete("serve/queue_wait", r.t_enqueue, w,
+                                     cat="serve", args={"n": r.n})
         try:
             if total > self._max_batch:
                 # one oversized request: chunk through max-size slices
@@ -226,12 +243,14 @@ class DynamicBatcher:
         device_s = time.perf_counter() - t_start
         if self._metrics is not None:
             self._metrics.record_batch(total, bucket_rows, waits, device_s)
-        done = time.perf_counter()
-        for r, yr in zip(batch, ys):  # submission order -> response order
-            if not r.future.cancelled():
-                r.future.set_result(yr)
-            if self._metrics is not None:
-                self._metrics.record_done(done - r.t_enqueue)
+        with _tracer.span("serve/slice_back", cat="serve",
+                          requests=len(batch), rows=total):
+            done = time.perf_counter()
+            for r, yr in zip(batch, ys):  # submission order -> response order
+                if not r.future.cancelled():
+                    r.future.set_result(yr)
+                if self._metrics is not None:
+                    self._metrics.record_done(done - r.t_enqueue)
 
     def _loop(self) -> None:
         while True:

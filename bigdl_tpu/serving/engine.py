@@ -26,12 +26,16 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from bigdl_tpu.obs import (env_watchdog_enabled, env_watchdog_kwargs,
+                           get_registry, get_tracer, shared_watchdog)
 from bigdl_tpu.serving.batcher import DynamicBatcher, power_of_two_buckets
 from bigdl_tpu.serving.compile_cache import CompileCache
 from bigdl_tpu.serving.host_transfer import HostStager
 from bigdl_tpu.serving.metrics import ServingMetrics
 from bigdl_tpu.utils.engine import Engine, select_platform
 from bigdl_tpu.utils.transfer import DEFAULT_CHUNK_BYTES
+
+_tracer = get_tracer()
 
 
 class ServingEngine:
@@ -102,7 +106,15 @@ class ServingEngine:
         self.cache = CompileCache(_infer, max_entries=max_cache_entries,
                                   donate_x=donate_x)
         self.stager = HostStager(self._dtype, chunk_bytes=chunk_bytes)
-        self.metrics = ServingMetrics()
+        # live metrics, published into the process-wide obs registry
+        # (latest engine owns the serving/* names)
+        self.metrics = ServingMetrics().publish_to(get_registry())
+        # dispatch-cadence stall detection: a device call that hangs
+        # (the tunneled-backend wedge) fires diagnose_tpu + stack dumps
+        # into the trace instead of silently stalling every client
+        self.watchdog = (shared_watchdog("serve_dispatch")
+                         .reset(**env_watchdog_kwargs())
+                         if env_watchdog_enabled() else None)
         self.batcher = DynamicBatcher(
             self._run_batch,
             max_batch_size=max_batch_size,
@@ -116,14 +128,29 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
     def _run_batch(self, x_padded: np.ndarray):
         """Batcher callback: stage, run the bucket executable, sync."""
-        xd = self.stager.stage(x_padded)
-        y = self.cache(self._params, self._buffers, xd)
-        if not hasattr(y, "shape"):
-            raise TypeError(
-                f"ServingEngine requires a single-array model output "
-                f"with a leading batch dim; got {type(y).__name__} "
-                "(pytree outputs are a ROADMAP follow-on)")
-        return np.asarray(y)  # host pull doubles as the device sync
+        if self.watchdog is not None:
+            self.watchdog.step_started()
+        try:
+            misses0 = (self.cache.stats()["misses"] if _tracer.enabled
+                       else 0)
+            with _tracer.span("serve/h2d", cat="serve",
+                              rows=int(x_padded.shape[0])):
+                xd = self.stager.stage(x_padded)
+            y = self.cache(self._params, self._buffers, xd)
+            if _tracer.enabled:
+                miss = self.cache.stats()["misses"] > misses0
+                _tracer.instant(
+                    "serve/cache_miss" if miss else "serve/cache_hit",
+                    cat="serve", bucket=int(x_padded.shape[0]))
+            if not hasattr(y, "shape"):
+                raise TypeError(
+                    f"ServingEngine requires a single-array model output "
+                    f"with a leading batch dim; got {type(y).__name__} "
+                    "(pytree outputs are a ROADMAP follow-on)")
+            return np.asarray(y)  # host pull doubles as the device sync
+        finally:
+            if self.watchdog is not None:
+                self.watchdog.step_finished()
 
     def _coerce(self, x, batched: bool) -> np.ndarray:
         x = np.asarray(x, self._dtype)
@@ -168,13 +195,17 @@ class ServingEngine:
 
     # ------------------------------------------------------------------ #
     def stats(self) -> dict:
-        return {
+        out = {
             "pending": self.batcher.pending(),
             "buckets": list(self.batcher.buckets),
             "compile_cache": self.cache.stats(),
             "host_transfer": self.stager.stats(),
             "metrics": self.metrics.snapshot(self.cache.stats()),
         }
+        if self.watchdog is not None:
+            out["watchdog"] = {"stalls": self.watchdog.stall_count,
+                               "median_dispatch_s": self.watchdog.median()}
+        return out
 
     def export_metrics(self, summary, step: int) -> None:
         """Write the current snapshot through a visualization Summary."""

@@ -7,73 +7,33 @@ throughput, batch occupancy (how full the padded bucket actually was)
 and the compile-cache hit rate.  ``export_to_summary`` writes the
 snapshot through the existing ``visualization`` tfevents writers, so
 serving dashboards land next to the training ones.
+
+The histogram class lives in :mod:`bigdl_tpu.obs.registry` (it is the
+registry's generic log-bucket ``Histogram``); ``LatencyHistogram``
+stays importable from here for compatibility.  ``publish_to`` exposes
+an engine's live histograms/counters in the process-wide registry.
+
+``throughput_eps`` is computed over a sliding window (default 60s), so
+an idle gap stops depressing the number the moment traffic resumes;
+the lifetime average — the old semantics, examples since engine start —
+is kept under ``throughput_eps_lifetime``.
 """
 from __future__ import annotations
 
-import bisect
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Dict, Optional
 
-
-def _log_edges() -> List[float]:
-    # 10us .. ~100s, ~7% geometric steps: fine enough for p99 on a
-    # millisecond-scale serving path, small enough to snapshot cheaply
-    edges = []
-    v = 1e-5
-    while v < 100.0:
-        edges.append(v)
-        v *= 1.07
-    return edges
-
-
-_EDGES = _log_edges()
-
-
-class LatencyHistogram:
-    """Fixed log-bucket histogram over seconds, with percentile
-    estimation (upper bucket edge — a conservative answer for a p99
-    SLO check)."""
-
-    def __init__(self):
-        self._counts = [0] * (len(_EDGES) + 1)
-        self.count = 0
-        self.sum = 0.0
-        self.max = 0.0
-
-    def observe(self, seconds: float) -> None:
-        self._counts[bisect.bisect_left(_EDGES, seconds)] += 1
-        self.count += 1
-        self.sum += seconds
-        if seconds > self.max:
-            self.max = seconds
-
-    def percentile(self, p: float) -> Optional[float]:
-        """p in [0, 100]; None when empty."""
-        if not self.count:
-            return None
-        rank = max(1, int(round(self.count * p / 100.0)))
-        seen = 0
-        for i, c in enumerate(self._counts):
-            seen += c
-            if seen >= rank:
-                return _EDGES[i] if i < len(_EDGES) else self.max
-        return self.max
-
-    def snapshot(self) -> dict:
-        return {
-            "count": self.count,
-            "mean_s": (self.sum / self.count) if self.count else None,
-            "p50_s": self.percentile(50),
-            "p99_s": self.percentile(99),
-            "max_s": self.max if self.count else None,
-        }
+from bigdl_tpu.obs.registry import (_EDGES, FnGauge,  # noqa: F401
+                                    Histogram as LatencyHistogram,
+                                    MetricRegistry)
 
 
 class ServingMetrics:
     """One engine's counters; thread-safe (batcher worker + callers)."""
 
-    def __init__(self):
+    def __init__(self, throughput_window_s: float = 60.0):
         self._lock = threading.Lock()
         self.queue_wait = LatencyHistogram()
         self.device_time = LatencyHistogram()
@@ -85,6 +45,28 @@ class ServingMetrics:
         self.batch_examples = 0    # real examples across dispatches
         self.padded_examples = 0   # bucket slots across dispatches
         self.started_at = time.perf_counter()
+        self._window_s = float(throughput_window_s)
+        self._recent: deque = deque()  # (t_done, n_examples) per dispatch
+
+    # -- registry wiring ------------------------------------------------ #
+    def publish_to(self, registry: MetricRegistry,
+                   prefix: str = "serving/") -> "ServingMetrics":
+        """Register the live histograms and computed counters in the
+        process-wide registry (latest engine wins the names)."""
+        registry.register(prefix + "queue_wait", self.queue_wait,
+                          replace=True)
+        registry.register(prefix + "device_time", self.device_time,
+                          replace=True)
+        registry.register(prefix + "total_latency", self.total_latency,
+                          replace=True)
+        for key in ("requests", "rejected", "examples", "batches"):
+            registry.register(prefix + key,
+                              FnGauge(lambda k=key: getattr(self, k)),
+                              replace=True)
+        registry.register(prefix + "throughput_eps",
+                          FnGauge(lambda: self.snapshot()["throughput_eps"]),
+                          replace=True)
+        return self
 
     # -- recording ------------------------------------------------------ #
     def record_submit(self) -> None:
@@ -98,10 +80,13 @@ class ServingMetrics:
     def record_batch(self, n_examples: int, bucket: int,
                      queue_waits_s, device_s: float) -> None:
         with self._lock:
+            now = time.perf_counter()
             self.batches += 1
             self.examples += n_examples
             self.batch_examples += n_examples
             self.padded_examples += bucket
+            self._recent.append((now, n_examples))
+            self._evict(now)
             self.device_time.observe(device_s)
             for w in queue_waits_s:
                 self.queue_wait.observe(w)
@@ -110,16 +95,31 @@ class ServingMetrics:
         with self._lock:
             self.total_latency.observe(total_s)
 
+    def _evict(self, now: float) -> None:
+        horizon = now - self._window_s
+        while self._recent and self._recent[0][0] < horizon:
+            self._recent.popleft()
+
     # -- reading -------------------------------------------------------- #
     def snapshot(self, cache_stats: Optional[dict] = None) -> dict:
         with self._lock:
-            elapsed = time.perf_counter() - self.started_at
+            now = time.perf_counter()
+            elapsed = now - self.started_at
+            self._evict(now)
+            # sliding-window rate: examples completed in the last
+            # window, over the window actually covered (a young engine
+            # divides by its age, not the full window)
+            span = min(elapsed, self._window_s)
+            windowed = sum(n for _, n in self._recent)
             snap = {
                 "requests": self.requests,
                 "rejected": self.rejected,
                 "examples": self.examples,
                 "batches": self.batches,
-                "throughput_eps": (self.examples / elapsed) if elapsed > 0 else 0.0,
+                "throughput_eps": (windowed / span) if span > 0 else 0.0,
+                "throughput_window_s": self._window_s,
+                "throughput_eps_lifetime":
+                    (self.examples / elapsed) if elapsed > 0 else 0.0,
                 "batch_occupancy": (self.batch_examples / self.padded_examples)
                                    if self.padded_examples else None,
                 "mean_batch_size": (self.batch_examples / self.batches)
@@ -141,6 +141,7 @@ class ServingMetrics:
             "Serving/Requests": snap["requests"],
             "Serving/Rejected": snap["rejected"],
             "Serving/ThroughputEPS": snap["throughput_eps"],
+            "Serving/ThroughputEPSLifetime": snap["throughput_eps_lifetime"],
             "Serving/BatchOccupancy": snap["batch_occupancy"],
             "Serving/QueueWaitP50": snap["queue_wait"]["p50_s"],
             "Serving/QueueWaitP99": snap["queue_wait"]["p99_s"],

@@ -31,6 +31,9 @@ def chunked_device_put(x_host, dtype=None, *,
     import jax.numpy as jnp
     import numpy as np
 
+    from bigdl_tpu.obs.tracer import get_tracer
+    _tr = get_tracer()
+
     x_host = np.asarray(x_host)
     target = jnp.dtype(dtype) if dtype is not None else x_host.dtype
 
@@ -55,14 +58,20 @@ def chunked_device_put(x_host, dtype=None, *,
         return out
 
     parts = []
+    itemsize = jnp.dtype(target).itemsize
     for i in range(0, n, rows):
-        p = _put(x_host[i:i + rows])
-        # one in-flight slice at a time — device_put is async, so
-        # building the list without blocking would enqueue every slice
-        # at once, recreating the oversized burst
-        p.block_until_ready()
+        piece = x_host[i:i + rows]
+        with _tr.span("h2d/chunk", cat="transfer", offset_rows=i,
+                      rows=int(piece.shape[0]),
+                      bytes=int(piece.size) * itemsize):
+            p = _put(piece)
+            # one in-flight slice at a time — device_put is async, so
+            # building the list without blocking would enqueue every
+            # slice at once, recreating the oversized burst
+            p.block_until_ready()
         parts.append(p)
-    out = jnp.concatenate(parts, axis=0)
-    out.block_until_ready()
+    with _tr.span("h2d/assemble", cat="transfer", chunks=len(parts)):
+        out = jnp.concatenate(parts, axis=0)
+        out.block_until_ready()
     del parts  # don't hold a second copy of the batch alive
     return out

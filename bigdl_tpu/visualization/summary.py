@@ -134,3 +134,13 @@ class ServingSummary(Summary):
 
     def __init__(self, log_dir: str, app_name: str):
         super().__init__(log_dir, app_name, "serving")
+
+
+class ObsSummary(Summary):
+    """Whole-registry logger: ``obs.get_registry().export_to_summary``
+    writes every registered counter/gauge/histogram here — the unified
+    snapshot (training phase counters + serving latency percentiles in
+    one folder, ``<logdir>/<app>/obs``)."""
+
+    def __init__(self, log_dir: str, app_name: str):
+        super().__init__(log_dir, app_name, "obs")

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Validate a Chrome-trace-event JSON file (TRACE_*.json) against the
+trace-event schema subset the obs tracer emits.
+
+Usage:
+    python scripts/validate_trace.py TRACE_BENCH.json [more.json ...]
+
+Checks (per the Trace Event Format doc, JSON Object Format):
+  - document is an object with a ``traceEvents`` list (or a bare list);
+  - every event is an object with string ``name``/``ph`` and numeric
+    ``ts``; ``pid``/``tid`` present and integral;
+  - ``ph`` is one of the phases the tracer emits (X complete, i/I
+    instant, M metadata) — anything else is flagged;
+  - complete events (``ph == "X"``) carry a numeric non-negative
+    ``dur``;
+  - instant events carry a valid scope (``s`` in g/p/t) when present;
+  - timestamps are non-negative and finite.
+
+Importable: ``validate_trace(path) -> list[str]`` returns the problem
+list (empty == valid), so a fast tier-1 test can run the same checks
+in-process on a freshly exported trace.
+"""
+from __future__ import annotations
+
+import json
+import math
+import sys
+
+#: phases the obs tracer emits + the common ones a hand-edited or
+#: merged trace may legitimately contain
+_KNOWN_PHASES = frozenset("XBEiIMsnftPNODbe")
+
+
+def _check_event(ev, i: int, problems: list) -> None:
+    if not isinstance(ev, dict):
+        problems.append(f"event[{i}]: not an object ({type(ev).__name__})")
+        return
+    ph = ev.get("ph")
+    if not isinstance(ph, str) or len(ph) != 1:
+        problems.append(f"event[{i}]: missing/invalid ph {ph!r}")
+        return
+    if ph not in _KNOWN_PHASES:
+        problems.append(f"event[{i}]: unknown phase {ph!r}")
+    name = ev.get("name")
+    if not isinstance(name, str) or not name:
+        problems.append(f"event[{i}] ph={ph}: missing/empty name")
+    for key in ("pid", "tid"):
+        v = ev.get(key)
+        if not isinstance(v, int) or isinstance(v, bool):
+            problems.append(f"event[{i}] {name!r}: {key} not an int: {v!r}")
+    if ph == "M":
+        return  # metadata rows carry no ts in our output; args checked below
+    ts = ev.get("ts")
+    if not isinstance(ts, (int, float)) or isinstance(ts, bool) \
+            or not math.isfinite(ts) or ts < 0:
+        problems.append(f"event[{i}] {name!r}: bad ts {ts!r}")
+    if ph == "X":
+        dur = ev.get("dur")
+        if not isinstance(dur, (int, float)) or isinstance(dur, bool) \
+                or not math.isfinite(dur) or dur < 0:
+            problems.append(f"event[{i}] {name!r}: complete event with "
+                            f"bad dur {dur!r}")
+    if ph in ("i", "I"):
+        s = ev.get("s")
+        if s is not None and s not in ("g", "p", "t"):
+            problems.append(f"event[{i}] {name!r}: invalid instant "
+                            f"scope {s!r}")
+
+
+def validate_trace(path: str) -> list:
+    """Return a list of problems (empty means the file is valid)."""
+    problems: list = []
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable/not JSON: {e}"]
+    if isinstance(doc, list):  # bare-array form is legal trace JSON
+        events = doc
+    elif isinstance(doc, dict):
+        events = doc.get("traceEvents")
+        if not isinstance(events, list):
+            return [f"{path}: no traceEvents list"]
+    else:
+        return [f"{path}: top level is {type(doc).__name__}, "
+                "expected object or array"]
+    if not events:
+        problems.append(f"{path}: empty trace (no events)")
+    for i, ev in enumerate(events):
+        _check_event(ev, i, problems)
+    return problems
+
+
+def main(argv) -> int:
+    if not argv:
+        print(__doc__.strip().splitlines()[0], file=sys.stderr)
+        print("usage: validate_trace.py TRACE.json [...]", file=sys.stderr)
+        return 2
+    rc = 0
+    for path in argv:
+        problems = validate_trace(path)
+        if problems:
+            rc = 1
+            for p in problems[:50]:
+                print(f"FAIL {p}")
+            if len(problems) > 50:
+                print(f"... and {len(problems) - 50} more")
+        else:
+            with open(path) as f:
+                doc = json.load(f)
+            n = len(doc if isinstance(doc, list) else doc["traceEvents"])
+            print(f"OK   {path}: {n} events")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
