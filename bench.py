@@ -708,15 +708,22 @@ def _serve_bench(argv) -> int:
     import argparse
 
     ap = argparse.ArgumentParser(prog="bench.py --serve")
-    ap.add_argument("--json", default=os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVE.json"))
+    ap.add_argument("--json", default=None)
     ap.add_argument("--requests", type=int, default=int(
         os.environ.get("BIGDL_TPU_SERVE_REQUESTS", "160")))
     ap.add_argument("--max-batch", type=int, default=32)
     ap.add_argument("--max-wait-ms", type=float, default=3.0)
+    ap.add_argument("--quant", nargs="?", const="int8", default=None,
+                    choices=("int8", "bf16"),
+                    help="serve a weight-only quantized replica; "
+                         "writes BENCH_QUANT.json")
     ap.add_argument("--trace", action="store_true",
                     help="record obs spans; write TRACE_SERVE.json")
     args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_QUANT.json" if args.quant else "BENCH_SERVE.json")
 
     from bigdl_tpu.obs import get_tracer
     if args.trace:
@@ -737,7 +744,8 @@ def _serve_bench(argv) -> int:
               "max_wait_ms": args.max_wait_ms,
               "requests": args.requests,
               "mixed_sizes": list(_SERVE_MIXED_SIZES),
-              "dtype": "float32"}
+              "dtype": "float32",
+              "quant_dtype": args.quant or "f32"}
     prev = artifacts.load_resumable_rows(
         args.json,
         match=lambda doc, r: (doc.get("platform") == platform
@@ -746,7 +754,9 @@ def _serve_bench(argv) -> int:
         key=lambda r: r.get("stage"))
 
     rows: list = []
-    result = {"bench": "serving_mixed_batch", "platform": platform,
+    result = {"bench": ("serving_mixed_batch_quant" if args.quant
+                        else "serving_mixed_batch"),
+              "platform": platform,
               "config": config, "rows": rows, "complete": False}
 
     def flush():
@@ -754,7 +764,8 @@ def _serve_bench(argv) -> int:
 
     flush()
     model = LeNet5(class_num=10).build(seed=1)
-    eng = ServingEngine(model, input_shape=(784,),
+    served = model.quantize(args.quant) if args.quant else model
+    eng = ServingEngine(served, input_shape=(784,),
                         max_batch_size=args.max_batch,
                         max_wait_ms=args.max_wait_ms,
                         max_queue=max(args.requests, 256))
@@ -765,6 +776,25 @@ def _serve_bench(argv) -> int:
                      "compiled": compiled,
                      "warmup_s": round(time.perf_counter() - t0, 3)})
         flush()
+        if args.quant:
+            # weight-payload accounting: always recomputed (cheap), the
+            # number the quantization subsystem exists to win — sync
+            # predicts below also report quant error vs the f32 forward
+            rep = served.quant_report
+            rows.append({
+                "stage": "quant",
+                "quant_dtype": args.quant,
+                "bytes_f32": rep["bytes_orig"],
+                "bytes_quant": rep["bytes_quant"],
+                "bytes_saved": rep["bytes_saved"],
+                "payload_ratio": round(rep["payload_ratio"], 4),
+                "bytes_moved_chunked": eng.stats()["quant_bytes_staged"],
+                "max_abs_dequant_error": rep["max_abs_dequant_error"],
+                "per_layer_max_abs_err": {
+                    k: round(v, 6)
+                    for k, v in rep["per_layer_max_abs_err"].items()},
+            })
+            flush()
 
         stages = {
             "mixed_async": lambda: _serve_stage_mixed_async(
@@ -809,10 +839,20 @@ def _serve_bench(argv) -> int:
             "queue_wait_p99_s": snap["queue_wait"]["p99_s"],
             "device_time_p50_s": snap["device_time"]["p50_s"],
         }
+        if args.quant:
+            qrow = next(r for r in rows if r.get("stage") == "quant")
+            result["summary"].update({
+                "quant_dtype": args.quant,
+                "quant_payload_ratio": qrow["payload_ratio"],
+                "quant_bytes_saved": qrow["bytes_saved"],
+                "quant_bytes_moved_chunked": qrow["bytes_moved_chunked"],
+            })
         result["complete"] = True
         flush()
         print(json.dumps({
-            "metric": "lenet5_serving_mixed_throughput_examples_per_sec",
+            "metric": ("lenet5_serving_quant_mixed_throughput_"
+                       "examples_per_sec" if args.quant else
+                       "lenet5_serving_mixed_throughput_examples_per_sec"),
             "value": headline["throughput_eps"],
             "unit": "examples/sec", "platform": platform,
             **{k: v for k, v in result["summary"].items()

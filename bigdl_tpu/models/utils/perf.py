@@ -177,10 +177,15 @@ def run_scaling_sweep(model_name: str, per_chip_batch: int, iterations: int,
 
     from bigdl_tpu.utils import profiling
 
+    # provenance from what profiling ACTUALLY read at import — not a
+    # call-time os.environ re-read, which could disagree with the
+    # constant (env set after import, or set to a malformed value the
+    # import-time parse rejected)
     if ici_gbps is not None:
         ici_gbps_source = "--ici-gbps CLI value (caller-supplied)"
-    elif os.environ.get("BIGDL_TPU_ICI_GBPS"):
-        ici_gbps_source = "BIGDL_TPU_ICI_GBPS env override"
+    elif profiling.env_source("BIGDL_TPU_ICI_GBPS") == "env":
+        ici_gbps_source = ("BIGDL_TPU_ICI_GBPS env override "
+                           "(read at profiling import)")
     else:
         ici_gbps_source = (
             "planning number: v5e ICI ~100 GB/s/axis peak per public TPU "

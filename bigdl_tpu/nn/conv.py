@@ -25,6 +25,7 @@ from jax import lax
 from bigdl_tpu.nn.initialization import Default, InitializationMethod
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.nn._util import match_compute_dtype
+from bigdl_tpu.quant.qtensor import is_qtensor
 
 
 def _dn(data_format: str):
@@ -90,14 +91,24 @@ class SpatialConvolution(Module):
         squeeze = x.ndim == 3
         if squeeze:  # CHW -> NCHW (the reference accepts 3-D input)
             x = x[None]
-        x = match_compute_dtype(x, params["weight"])
-        y = lax.conv_general_dilated(
-            x, params["weight"],
-            window_strides=(self.stride_h, self.stride_w),
-            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
-            dimension_numbers=_dn(self.data_format),
-            feature_group_count=self.n_group,
-        )
+        w = params["weight"]
+        if is_qtensor(w):
+            from bigdl_tpu.quant.kernels import qconv
+            y = qconv(x, w,
+                      window_strides=(self.stride_h, self.stride_w),
+                      padding=((self.pad_h, self.pad_h),
+                               (self.pad_w, self.pad_w)),
+                      dimension_numbers=_dn(self.data_format),
+                      feature_group_count=self.n_group)
+        else:
+            x = match_compute_dtype(x, w)
+            y = lax.conv_general_dilated(
+                x, w,
+                window_strides=(self.stride_h, self.stride_w),
+                padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+                dimension_numbers=_dn(self.data_format),
+                feature_group_count=self.n_group,
+            )
         if self.with_bias:
             y = _add_bias(y, params["bias"], self.data_format)
         return y[0] if squeeze else y
@@ -128,14 +139,24 @@ class SpatialDilatedConvolution(SpatialConvolution):
         squeeze = x.ndim == 3
         if squeeze:
             x = x[None]
-        x = match_compute_dtype(x, params["weight"])
-        y = lax.conv_general_dilated(
-            x, params["weight"],
-            window_strides=(self.stride_h, self.stride_w),
-            padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
-            rhs_dilation=(self.dilation_h, self.dilation_w),
-            dimension_numbers=_dn(self.data_format),
-        )
+        w = params["weight"]
+        if is_qtensor(w):
+            from bigdl_tpu.quant.kernels import qconv
+            y = qconv(x, w,
+                      window_strides=(self.stride_h, self.stride_w),
+                      padding=((self.pad_h, self.pad_h),
+                               (self.pad_w, self.pad_w)),
+                      rhs_dilation=(self.dilation_h, self.dilation_w),
+                      dimension_numbers=_dn(self.data_format))
+        else:
+            x = match_compute_dtype(x, w)
+            y = lax.conv_general_dilated(
+                x, w,
+                window_strides=(self.stride_h, self.stride_w),
+                padding=((self.pad_h, self.pad_h), (self.pad_w, self.pad_w)),
+                rhs_dilation=(self.dilation_h, self.dilation_w),
+                dimension_numbers=_dn(self.data_format),
+            )
         if self.with_bias:
             y = _add_bias(y, params["bias"], self.data_format)
         return y[0] if squeeze else y

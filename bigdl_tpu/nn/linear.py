@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from bigdl_tpu.nn.initialization import Default, InitializationMethod, Xavier
 from bigdl_tpu.nn.module import Module
 from bigdl_tpu.nn._util import match_compute_dtype
+from bigdl_tpu.quant.qtensor import is_qtensor
 from bigdl_tpu.utils.table import Table
 
 
@@ -45,8 +46,13 @@ class Linear(Module):
         return p
 
     def f(self, params, x, **kw):
-        x = match_compute_dtype(jnp.asarray(x), params["weight"])
-        y = x @ params["weight"].T
+        w = params["weight"]
+        if is_qtensor(w):
+            from bigdl_tpu.quant.kernels import qlinear
+            return qlinear(x, w, params.get("bias")
+                           if self.with_bias else None)
+        x = match_compute_dtype(jnp.asarray(x), w)
+        y = x @ w.T
         if self.with_bias:
             y = y + params["bias"]
         return y

@@ -135,6 +135,12 @@ class Module:
         key = ("apply", training)
         if key not in self._jit_cache:
             def run(params, buffers, x, rng):
+                # quantized params: expand non-native QTensors here,
+                # inside the trace — int8 stays the stored form, the
+                # dequant fuses into the consumers (identity for f32
+                # trees; see quant/transform.dequantize_entry)
+                from bigdl_tpu.quant.transform import dequantize_entry
+                params = dequantize_entry(params)
                 return self.apply(params, x, buffers=buffers, training=training, rng=rng)
             self._jit_cache[key] = jax.jit(run)
         return self._jit_cache[key]
@@ -407,6 +413,43 @@ class Module:
         from bigdl_tpu.serving import ServingEngine
         self._built()
         return ServingEngine(self, **kwargs)
+
+    def quantize(self, dtype: str = "int8", *, policy=None) -> "Module":
+        """Weight-only quantized EVAL-MODE clone of this built module
+        (``self`` keeps its f32 params untouched — both replicas can be
+        served side by side, the compile cache keys them apart).
+
+        ``dtype="int8"``: eligible weights become
+        :class:`~bigdl_tpu.quant.QTensor` (int8 + per-channel f32
+        scales); Linear/Conv dequantize on the fly inside their MXU
+        kernel (bf16 operands, f32 accumulation), everything else
+        expands at the jit entry.  ``dtype="bf16"``: a plain storage
+        cast.  The include/exclude ``policy`` defaults skip norms,
+        biases and embedding tables (see quant.QuantPolicy).
+
+        The clone is inference-only: its int8 leaves are not
+        differentiable, so train on the f32 original and re-quantize.
+        Byte savings and per-layer max abs dequant error are published
+        as ``quant/*`` gauges on the obs registry and kept on
+        ``clone.quant_report``.
+        """
+        from bigdl_tpu.obs import get_registry
+        from bigdl_tpu.quant import quantize_params
+        self._built()
+        report: dict = {}
+        new = self.clone_module()
+        new.params = quantize_params(self.params, dtype, policy=policy,
+                                     module=self, report=report)
+        new.grad_params = None  # int8 leaves are not differentiable
+        new.quant_report = report
+        reg = get_registry()
+        reg.gauge("quant/bytes_saved", unit="B").set(report["bytes_saved"])
+        reg.gauge("quant/payload_ratio").set(report["payload_ratio"])
+        reg.gauge("quant/max_abs_dequant_error").set(
+            report["max_abs_dequant_error"])
+        for path, err in report["per_layer_max_abs_err"].items():
+            reg.gauge(f"quant/max_abs_dequant_error/{path}").set(err)
+        return new.evaluate()
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}"

@@ -111,8 +111,12 @@ def _sds(shape, dtype, *like):
     over — e.g. replicated q with sequence-sharded k/v), so the kernel
     works inside shard_map (check_vma) and outside it."""
     vma = frozenset()
-    for x in like:
-        vma = vma | (getattr(jax.typeof(x), "vma", None) or frozenset())
+    # jax.typeof is newer than 0.4.x; without it there is no vma concept
+    # (shard_map check_vma came with it) so a plain struct is correct
+    typeof = getattr(jax, "typeof", None)
+    if typeof is not None:
+        for x in like:
+            vma = vma | (getattr(typeof(x), "vma", None) or frozenset())
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

@@ -1,0 +1,31 @@
+"""bigdl_tpu.quant — int8/bf16 weight-only quantization.
+
+The inference-precision subsystem (ref: BigDL's int8 model quantization,
+arXiv 1804.05839; BigDL 2.0 Nano's inference optimizations, arXiv
+2204.01715).  Weight-only and symmetric: params are stored as int8 with
+per-channel f32 scales (:class:`QTensor`), activations stay in the
+compute dtype, and the MXU contraction runs bf16 operands with f32
+accumulation (the ops/flash_attention.py recipe).
+
+Entry points:
+
+- ``model.quantize("int8")``       — eval-mode quantized clone (nn.Module)
+- :func:`quantize_params`          — the pytree-level transform + policy
+- ``ServingEngine(qmodel, ...)``   — serves int8 replicas through the
+  same compile cache as f32 ones (quant dtype is part of the bucket key)
+- ``bench.py --serve --quant``     — resumable BENCH_QUANT.json
+"""
+from bigdl_tpu.quant.qtensor import (QMAX, QTensor, dequantize_array,
+                                     is_qtensor, quantize_array)
+from bigdl_tpu.quant.kernels import qconv, qlinear
+from bigdl_tpu.quant.transform import (QuantPolicy, dequantize_entry,
+                                       dequantize_params, params_dtype_tag,
+                                       params_nbytes, quantize_params,
+                                       stage_quantized_params)
+
+__all__ = [
+    "QMAX", "QTensor", "QuantPolicy", "dequantize_array",
+    "dequantize_entry", "dequantize_params", "is_qtensor",
+    "params_dtype_tag", "params_nbytes", "qconv", "qlinear",
+    "quantize_array", "quantize_params", "stage_quantized_params",
+]

@@ -1,0 +1,59 @@
+"""Dequant-on-the-fly kernels for the natively quantized layers.
+
+The MXU recipe mirrors ops/flash_attention.py: matmul/conv operands in
+bf16 (full MXU rate on TPU), accumulation in f32 via
+``preferred_element_type`` — never bf16 accumulation, never f32
+operands.  The int8 weight is expanded ``q * scale`` in f32 and rounded
+once to bf16 right at the operand seam; XLA fuses the expand into the
+producing loop, so no f32 copy of the weight ever materializes in HBM —
+the whole point of int8 storage.
+
+Activations arrive f32 (or whatever the caller computes in) and are
+cast to bf16 for the contraction; the result is returned in the
+weight's pre-quantization dtype (f32 for imported checkpoints) with the
+bias added in f32 *after* accumulation.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from bigdl_tpu.quant.qtensor import QTensor
+
+
+def _operand(x):
+    """bf16 MXU operand for a float activation; integer inputs (none of
+    the native layers take them) pass through untouched."""
+    x = jnp.asarray(x)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        return x.astype(jnp.bfloat16)
+    return x
+
+
+def qlinear(x, qweight: QTensor, bias=None):
+    """Quantized ``y = x @ W.T + b`` (nn.Linear semantics, weight
+    ``(out, in)`` with per-out-channel scales ``(out, 1)``)."""
+    w = qweight.dequantize(jnp.bfloat16)
+    y = jnp.matmul(_operand(x), w.T,
+                   preferred_element_type=jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(jnp.dtype(qweight.orig_dtype))
+
+
+def qconv(x, qweight: QTensor, *, window_strides, padding,
+          dimension_numbers, feature_group_count: int = 1,
+          rhs_dilation=None):
+    """Quantized ``lax.conv_general_dilated`` (OIHW weight with
+    per-out-plane scales ``(O, 1, 1, 1)``)."""
+    w = qweight.dequantize(jnp.bfloat16)
+    y = lax.conv_general_dilated(
+        _operand(x), w,
+        window_strides=window_strides,
+        padding=padding,
+        dimension_numbers=dimension_numbers,
+        feature_group_count=feature_group_count,
+        rhs_dilation=rhs_dilation,
+        preferred_element_type=jnp.float32,
+    )
+    return y.astype(jnp.dtype(qweight.orig_dtype))

@@ -6,9 +6,12 @@ could neither observe hit rates nor bound entries nor pre-warm.  This
 cache is the explicit version: entries are ahead-of-time compiled
 executables (``jit(fn).lower(...).compile()``) keyed on
 
-    (bucket input shape, input dtype, donate flags)
+    (bucket input shape, input dtype, donate flags, params quant dtype)
 
-with hit/miss/evict counters and a warmup API that pre-traces the
+— the last component is what lets one cache hold f32 and int8 replicas
+of the same model simultaneously (quant.params_dtype_tag: "int8" when
+the params tree carries QTensor leaves, "bf16"/"f32" otherwise), with
+hit/miss/evict counters and a warmup API that pre-traces the
 configured buckets before traffic arrives.  The batcher pads every
 batch to a configured bucket, so steady state is all hits and the
 cache stays small and warm (TensorFlow-serving's lesson, arXiv
@@ -24,14 +27,16 @@ import threading
 from collections import OrderedDict
 from typing import Callable, Sequence, Tuple
 
-Key = Tuple[tuple, str, tuple]
+Key = Tuple[tuple, str, tuple, str]
 
 
 class CompileCache:
     """AOT-compile cache for ``fn(params, buffers, x) -> y``.
 
     ``params``/``buffers`` are the frozen model state (same pytree every
-    call — their shapes are part of the trace but not of the key);
+    call — their shapes are part of the trace but not of the key, with
+    one exception: their quant dtype tag IS keyed, so a caller serving
+    f32 and int8 replicas of one model gets one executable each);
     ``x`` is the padded batch whose (shape, dtype) keys the entry.
     """
 
@@ -51,8 +56,10 @@ class CompileCache:
         self.evictions = 0
 
     # ------------------------------------------------------------------ #
-    def key_for(self, x) -> Key:
-        return (tuple(x.shape), str(x.dtype), self._donate)
+    def key_for(self, x, params=None) -> Key:
+        from bigdl_tpu.quant import params_dtype_tag
+        return (tuple(x.shape), str(x.dtype), self._donate,
+                params_dtype_tag(params) if params is not None else "f32")
 
     def _compile(self, params, buffers, x) -> Callable:
         return self._jit.lower(params, buffers, x).compile()
@@ -60,7 +67,7 @@ class CompileCache:
     def __call__(self, params, buffers, x):
         """Run ``fn`` through the cached executable for x's shape
         bucket, compiling (miss) on first sight."""
-        key = self.key_for(x)
+        key = self.key_for(x, params)
         with self._lock:
             entry = self._entries.get(key)
             if entry is not None:
@@ -90,7 +97,7 @@ class CompileCache:
         compiled = 0
         for shape in shapes:
             x = jnp.zeros(shape, dtype)
-            key = self.key_for(x)
+            key = self.key_for(x, params)
             with self._lock:
                 present = key in self._entries
             if present:

@@ -72,6 +72,14 @@ def record_layer_inputs(model: Module, x, training: bool = False,
 import os as _os
 
 
+#: where each planning constant's value actually came from at import
+#: time: "env" | "default" | "env-malformed-default".  Consumers that
+#: report provenance (models/utils/perf.py's ici_gbps_source) must read
+#: THIS, not re-read os.environ at call time — the env can change (or
+#: be set malformed) after import without changing the constant.
+_ENV_SOURCES: dict = {}
+
+
 def _env_float(name: str, default: float) -> float:
     """Env override with a loud-but-survivable parse: a malformed value
     must not break `import bigdl_tpu.parallel` for code that never
@@ -79,14 +87,24 @@ def _env_float(name: str, default: float) -> float:
     before importing (they are planning constants, not runtime knobs)."""
     raw = _os.environ.get(name)
     if raw is None:
+        _ENV_SOURCES[name] = "default"
         return default
     try:
-        return float(raw)
+        value = float(raw)
+        _ENV_SOURCES[name] = "env"
+        return value
     except ValueError:
         import warnings
         warnings.warn(f"{name}={raw!r} is not a number; using the "
                       f"default {default}")
+        _ENV_SOURCES[name] = "env-malformed-default"
         return default
+
+
+def env_source(name: str) -> str:
+    """Provenance of a planning constant as read at import:
+    "env", "default", or "env-malformed-default"."""
+    return _ENV_SOURCES.get(name, "default")
 
 
 #: planning numbers for the roofline attribution — default v5e (~197

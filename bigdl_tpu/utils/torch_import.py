@@ -213,11 +213,30 @@ def load_torch_state_dict(model, state_dict, *, strict: bool = True):
 
     ours = list(_walk_leaves(model, params, buffers, ""))
     theirs = group_state_dict(state_dict)
-    if len(ours) != len(theirs) and strict:
-        raise ValueError(
-            f"module count mismatch: model has {len(ours)} "
-            f"parameterized leaves, state_dict has {len(theirs)} "
-            f"groups\n{_inventory(ours, theirs)}")
+    if len(ours) != len(theirs):
+        if strict:
+            raise ValueError(
+                f"module count mismatch: model has {len(ours)} "
+                f"parameterized leaves, state_dict has {len(theirs)} "
+                f"groups\n{_inventory(ours, theirs)}")
+        # strict=False truncates to the common positional prefix — say
+        # exactly what fell off each side, because a count mismatch
+        # usually means the alignment SHIFTED somewhere earlier and the
+        # "matched" prefix is silently importing wrong weights
+        n = min(len(ours), len(theirs))
+        unmatched_ours = [
+            f"{path or '<root>'} ({type(m).__name__}"
+            f"{sorted(p) + sorted(b)})"
+            for path, m, p, b, _pr in ours[n:]]
+        unmatched_theirs = [f"{prefix} ({sorted(g)})"
+                            for prefix, g in theirs[n:]]
+        log.warning(
+            "strict=False: copying the first %d positional groups; "
+            "%d model leaves left unmatched: %s; %d state-dict groups "
+            "left unmatched: %s — verify the matched prefix is really "
+            "aligned (a skipped module shifts every later group)",
+            n, len(unmatched_ours), unmatched_ours or "none",
+            len(unmatched_theirs), unmatched_theirs or "none")
     for (path, mod, p_leaf, b_leaf, _proto), (prefix, group) in zip(ours, theirs):
         group = _adapt_torch_rnn_group(mod, p_leaf, group, prefix, path)
         for leaf_name, value in group.items():

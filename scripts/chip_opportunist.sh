@@ -12,7 +12,10 @@
 #
 #     bash scripts/chip_opportunist.sh [logfile]
 #
-# Exits 0 when every stage's artifact is valid.
+# Exits 0 when every stage's artifact is valid; exits 3 (after
+# committing whatever landed) when OPP_MAX_RUNTIME_S (default 6h) or
+# OPP_MAX_DEAD_PROBES consecutive dead probes (default 240, ~3h) run
+# out first — a windowless round must terminate, not probe forever.
 set -u
 cd "$(dirname "$0")/.."
 LOG="${1:-opportunist.log}"
@@ -171,7 +174,31 @@ say "opportunist start"
 scan_tries=0
 stress_tries=0
 regen_done=0
+# Termination bounds for a windowless round: without them the battery
+# probes a dead backend forever (each dead cycle = one 30s probe + 20s
+# sleep).  OPP_MAX_RUNTIME_S caps wall time since start;
+# OPP_MAX_DEAD_PROBES caps CONSECUTIVE dead probes (any live window
+# resets the streak).  0 disables a bound.  Exit code 3 = bounded out
+# with work incomplete — partial artifacts are committed first, and
+# bench.py's round-end supervisor still replays the last real
+# measurement.
+MAX_RUNTIME_S="${OPP_MAX_RUNTIME_S:-21600}"
+MAX_DEAD_PROBES="${OPP_MAX_DEAD_PROBES:-240}"
+START_TS=$(date +%s)
+dead_streak=0
 while :; do
+  if [ "$MAX_RUNTIME_S" -gt 0 ] \
+      && [ $(( $(date +%s) - START_TS )) -ge "$MAX_RUNTIME_S" ]; then
+    commit_artifacts "TPU measurement battery: partial state at runtime bound"
+    say "max runtime ${MAX_RUNTIME_S}s reached - exiting (incomplete)"
+    exit 3
+  fi
+  if [ "$MAX_DEAD_PROBES" -gt 0 ] \
+      && [ "$dead_streak" -ge "$MAX_DEAD_PROBES" ]; then
+    commit_artifacts "TPU measurement battery: partial state, backend never answered"
+    say "$dead_streak consecutive dead probes - exiting (incomplete)"
+    exit 3
+  fi
   all_done=1
   for probe_art in BENCH_LAST.json BENCH_ATTN.json BENCH_LM.json \
                    BENCH_PIPELINE.json PROFILE_TPU.json; do
@@ -196,6 +223,7 @@ while :; do
     fi
   fi
   if alive; then
+    dead_streak=0
     say "chip ALIVE - draining stages"
     # Highest value first; each stage re-checks its own artifact so a
     # completed one is skipped instantly on later passes.
@@ -262,7 +290,8 @@ while :; do
       say "measurements complete, backend dead - exiting without bonus"
       exit 0
     fi
-    say "probe: dead"
+    dead_streak=$((dead_streak + 1))
+    say "probe: dead (streak $dead_streak)"
     sleep 20
   fi
 done

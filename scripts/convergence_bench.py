@@ -128,7 +128,14 @@ def run_stage(args, stage, doc, platform):
             and section.get("done")):
         print(f"[{stage}] section complete, reusing", flush=True)
         return
-    ckpt_dir = os.path.join(args.workdir, f"{stage}-{platform}")
+    # checkpoint dir keyed on the full stage config: a run with changed
+    # knobs (epochs/records/batch, smoke vs full) must never resume —
+    # or let the reconstruct branch below fabricate an "epoch 1" row —
+    # from a stale different-config checkpoint
+    import hashlib
+    cfg_tag = hashlib.sha1(
+        json.dumps(cfg, sort_keys=True).encode()).hexdigest()[:10]
+    ckpt_dir = os.path.join(args.workdir, f"{stage}-{platform}-{cfg_tag}")
     if args.fresh and os.path.isdir(ckpt_dir):
         shutil.rmtree(ckpt_dir)
     rows = []

@@ -40,12 +40,36 @@ def main(argv=None) -> None:
         print(f"--only set: writing filtered results to {args.json}",
               file=sys.stderr)
 
+    # before the first backend use: 8 virtual CPU devices so the
+    # concrete-mesh fallback below has devices to build from (harmless
+    # when the AbstractMesh path is taken)
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    import numpy as np
     import jax.numpy as jnp
     from jax import export, lax
-    from jax.sharding import AbstractMesh, NamedSharding, PartitionSpec as P
+    from jax.sharding import (AbstractMesh, Mesh, NamedSharding,
+                              PartitionSpec as P)
+
+    def abstract_mesh(shape, names):
+        # newer jax: AbstractMesh(shape, axis_names).  0.4.x spells it
+        # ((name, size), ...) — but there NamedSharding over an abstract
+        # mesh cannot lower (`_device_assignment` not implemented), so
+        # prefer a concrete mesh of virtual CPU devices; the export
+        # still targets platforms=["tpu"]
+        try:
+            return AbstractMesh(shape, names)
+        except TypeError:
+            n = int(np.prod(shape))
+            devs = jax.devices("cpu")
+            if len(devs) >= n:
+                return Mesh(np.array(devs[:n]).reshape(shape), names)
+            return AbstractMesh(tuple(zip(names, shape)))
 
     from bigdl_tpu import nn
     from bigdl_tpu.models import ResNet, TransformerLM
@@ -154,7 +178,7 @@ def main(argv=None) -> None:
         # virtual CPU mesh the dryrun uses
         from bigdl_tpu.parallel.parameters import AllReduceParameter
 
-        mesh = AbstractMesh((8,), ("data",))
+        mesh = abstract_mesh((8,), ("data",))
         dmodel = nn.Sequential(nn.Linear(64, 128), nn.Tanh(),
                                nn.Linear(128, 10),
                                nn.LogSoftMax()).build(seed=1)
@@ -177,10 +201,12 @@ def main(argv=None) -> None:
             return new_w, new_opt, lax.pmean(loss, "data")
 
         opt_specs = {"iteration": P(), "velocity": P("data")}
-        mapped = jax.shard_map(
+        from bigdl_tpu.parallel.distri_optimizer import (_SHARD_MAP_NO_CHECK,
+                                                         shard_map)
+        mapped = shard_map(
             dp_step, mesh=mesh,
             in_specs=(P("data"), opt_specs, P("data"), P("data")),
-            out_specs=(P("data"), opt_specs, P()), check_vma=False)
+            out_specs=(P("data"), opt_specs, P()), **_SHARD_MAP_NO_CHECK)
         run_export("dp_zero1_shard_map_8tpu", mapped,
                    (jax.ShapeDtypeStruct((arp.padded_size,), jnp.float32),
                     {"iteration": jax.ShapeDtypeStruct((), jnp.int32),
@@ -193,7 +219,7 @@ def main(argv=None) -> None:
         # sequence parallel: ring attention (ppermute + online softmax)
         from bigdl_tpu.models.transformer.sp import ring_lm_apply
 
-        sp_mesh = AbstractMesh((2, 4), (DATA_AXIS, SEQUENCE_AXIS))
+        sp_mesh = abstract_mesh((2, 4), (DATA_AXIS, SEQUENCE_AXIS))
         B, T = 4, 8192
         sp_model = TransformerLM(vocab_size=32000, hidden_size=512,
                                  n_head=8, n_layers=2,
@@ -225,7 +251,7 @@ def main(argv=None) -> None:
         from bigdl_tpu.parallel.tensor_parallel import (
             constrain_batch, pin_xla_attention, transformer_lm_tp_rules)
 
-        tp_mesh = AbstractMesh((2, 4), (DATA_AXIS, MODEL_AXIS))
+        tp_mesh = abstract_mesh((2, 4), (DATA_AXIS, MODEL_AXIS))
         tp_model = TransformerLM(vocab_size=32000, hidden_size=512,
                                  n_head=8, n_layers=2,
                                  max_len=2048).build(seed=0)
@@ -259,7 +285,7 @@ def main(argv=None) -> None:
         # pipeline parallel: GPipe microbatch schedule over 4 stages
         from bigdl_tpu.parallel.pipeline import pipeline_apply
 
-        pp_mesh = AbstractMesh((4,), (PIPELINE_AXIS,))
+        pp_mesh = abstract_mesh((4,), (PIPELINE_AXIS,))
         d_model = 512
 
         def pp_stage(p, h):
@@ -282,7 +308,7 @@ def main(argv=None) -> None:
         # expert parallel: switch-MoE all-to-all dispatch/combine
         from bigdl_tpu.parallel.expert import init_moe_params, moe_apply
 
-        ep_mesh = AbstractMesh((2, 4), (DATA_AXIS, EXPERT_AXIS))
+        ep_mesh = abstract_mesh((2, 4), (DATA_AXIS, EXPERT_AXIS))
         ep_params = init_moe_params(jax.random.PRNGKey(0), 8, 512, 2048)
 
         def ep_step(p, x):

@@ -320,4 +320,7 @@ class TestCheckpointRemat:
         g1, g2 = grads(build(True)), grads(build(False))
         for a, b in zip(jax.tree_util.tree_leaves(g1),
                         jax.tree_util.tree_leaves(g2)):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+            # remat replays the forward; XLA may fuse the replay
+            # differently, so allow a few ULPs (seen on jax 0.4.x CPU)
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
