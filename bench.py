@@ -151,11 +151,11 @@ def _load_cached_result():
     the default recipe's number as its own."""
     if os.environ.get("BIGDL_TPU_BENCH_REPLAY", "1") != "1":
         return None
-    try:
-        with open(_bench_last_path()) as f:
-            d = json.load(f)
-    except (OSError, ValueError):
-        return None
+    # shared corrupt-tolerant loader: a BENCH_LAST.json truncated by a
+    # kill mid-write warns and resumes nothing instead of crashing the
+    # supervisor at round end
+    from bigdl_tpu.utils.artifacts import load_artifact
+    d = load_artifact(_bench_last_path())
     if not isinstance(d, dict):
         return None
     if not (isinstance(d.get("value"), (int, float))

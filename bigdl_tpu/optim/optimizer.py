@@ -397,6 +397,113 @@ class Optimizer:
                      fs.join(self.checkpoint_path, f"state.{n}"), overwrite=True)
         log.info("checkpoint written at iteration %d", n)
 
+    # -- resilience: emergency checkpoint + resume ---------------------- #
+    def resume_from(self, path: str) -> "Optimizer":
+        """Auto-resume: load the newest ``model.<n>``/``state.<n>`` pair
+        under ``path`` (the directory ``set_checkpoint`` writes to —
+        including its emergency checkpoints) into this optimizer, so the
+        next ``optimize()`` continues the interrupted run: step/epoch
+        counters, optimizer moments, LR-schedule position, and mid-epoch
+        data progress (``records_processed``) all restore, losing at
+        most the one step that was in flight when the run died.
+
+        A missing/empty directory is a cold start, not an error — one
+        code path covers first launch and every restart after."""
+        from bigdl_tpu.utils import file_io
+        found = file_io.latest_checkpoint(path)
+        if not found:
+            log.info("resume_from(%s): no checkpoint pair found — "
+                     "cold start", path)
+            return self
+        model_path, state_path, n = found
+        from bigdl_tpu.models.utils import restore_optim_state
+        loaded = Module.load(model_path)
+        self.model._built()
+        self.model.params = loaded.params
+        self.model.buffers = loaded.buffers
+        restore_optim_state(self, self.optim_method, state_path)
+        from bigdl_tpu.obs import get_registry
+        get_registry().counter("resilience/resumes").add(1)
+        log.warning("resumed from %s (iteration %d, epoch %s, %s records "
+                    "into the epoch)", path, n, self.state.get("epoch"),
+                    self.state.get("records_processed", 0))
+        return self
+
+    def _publish_for_checkpoint(self) -> None:
+        """Make ``self.model.params``/``optim_method._state`` current
+        before an emergency checkpoint.  No-op locally (the loop
+        publishes every iteration); DistriOptimizer overrides with its
+        guarded device->host gather."""
+
+    def _emergency_checkpoint(self, reason: str = "") -> bool:
+        """Best-effort checkpoint of the LAST COMPLETED step, taken on
+        the failure path — so a crashed run restarts from
+        ``resume_from`` having lost at most the step that was in
+        flight.  Never raises: it runs inside exception handlers, and a
+        checkpoint failure must not mask the original error."""
+        if self.checkpoint_path is None:
+            log.warning("cannot write emergency checkpoint (%s): no "
+                        "checkpoint path configured — call "
+                        "set_checkpoint first", reason)
+            return False
+        try:
+            self._publish_for_checkpoint()
+        except Exception:
+            log.warning("publish before emergency checkpoint failed "
+                        "(backend gone?); writing last published host "
+                        "state instead", exc_info=True)
+        try:
+            self._checkpoint()
+        except Exception:
+            log.exception("emergency checkpoint failed (%s)", reason)
+            return False
+        from bigdl_tpu.obs import get_registry
+        get_registry().counter("resilience/emergency_checkpoints").add(1)
+        log.warning("emergency checkpoint written at iteration %d (%s)",
+                    self.state["neval"] - 1, reason)
+        return True
+
+    def _arm_stall_checkpoint(self, watchdog) -> None:
+        """Escalation chain: when the StallWatchdog fires (a wedged
+        device call), request an emergency checkpoint — taken by the
+        loop at the next completed iteration, where the published state
+        is consistent (the stalled step itself may still be running; a
+        checkpoint from the watchdog thread would race it)."""
+        self._stall_ckpt_requested = False
+        if watchdog is None:
+            return
+
+        def _on_stall(event):
+            self._stall_ckpt_requested = True
+
+        watchdog.on_stall = _on_stall
+
+    def _maybe_stall_checkpoint(self) -> None:
+        if getattr(self, "_stall_ckpt_requested", False):
+            self._stall_ckpt_requested = False
+            self._emergency_checkpoint(
+                "stall watchdog escalation: checkpointing at the next "
+                "completed iteration")
+
+    def _fast_forward_data(self, data_iter, records_into_epoch: int,
+                           scale: int = 1) -> None:
+        """Re-join an interrupted epoch's data order after resume_from:
+        replay the rollover shuffles the original run performed (the
+        dataset draws permutations from a seeded stream, so replay is
+        exact on a freshly constructed dataset), then consume the
+        records the interrupted epoch already trained on.  A cold start
+        (epoch 1, 0 records in) is a no-op.  ``scale`` converts a local
+        batch to its global record count (process count, distributed)."""
+        for _ in range(int(self.state.get("epoch", 1)) - 1):
+            self.dataset.shuffle()
+        skipped = 0
+        while skipped < records_into_epoch:
+            batch = next(data_iter)
+            skipped += int(np.asarray(batch.data).shape[0]) * int(scale)
+        if skipped:
+            log.info("resume fast-forward: skipped %d already-trained "
+                     "records to rejoin the epoch mid-stream", skipped)
+
 
 class LocalOptimizer(Optimizer):
     """Single-process training loop (ref optim/LocalOptimizer.scala:76-173).
@@ -456,6 +563,7 @@ class LocalOptimizer(Optimizer):
         data_iter = self.dataset.data(train=True)
 
         records_this_epoch = self.state.get("records_processed", 0)
+        self._fast_forward_data(data_iter, records_this_epoch)
         wall0 = time.perf_counter()
         # host/device overlap: jit dispatch is async, so the expensive
         # host work for the NEXT batch (decode/augment/stack) runs while
@@ -465,6 +573,35 @@ class LocalOptimizer(Optimizer):
         overlap = os.environ.get("BIGDL_TPU_PREFETCH_OVERLAP", "1") == "1"
         next_batch = None
         accum_checked = False
+        # step-cadence stall detection + escalation: a wedged device
+        # call fires diagnostics, and the escalation hook checkpoints
+        # at the next completed iteration (see _arm_stall_checkpoint)
+        from bigdl_tpu.obs import (env_watchdog_enabled,
+                                   env_watchdog_kwargs, shared_watchdog)
+        watchdog = None
+        if env_watchdog_enabled():
+            watchdog = shared_watchdog("train_step")
+            watchdog.reset(**env_watchdog_kwargs())
+        self._arm_stall_checkpoint(watchdog)
+        try:
+            self._optimize_loop(params, buffers, opt_state, rng, data_iter,
+                                dataset_size, records_this_epoch, overlap,
+                                next_batch, accum_checked, watchdog, wall0)
+        except Exception as e:
+            # crash resilience: persist the last completed step before
+            # surfacing the failure, so resume_from loses at most the
+            # in-flight step (the JAX rendering of the reference's
+            # recompute-from-lineage story — here state is explicit)
+            self._emergency_checkpoint(f"training loop failed: {e!r}")
+            raise
+        finally:
+            if watchdog is not None:
+                watchdog.on_stall = None
+        return self.model
+
+    def _optimize_loop(self, params, buffers, opt_state, rng, data_iter,
+                       dataset_size, records_this_epoch, overlap,
+                       next_batch, accum_checked, watchdog, wall0):
         while not self.end_when(self.state):
             self.state["epoch_finished"] = False
             batch = next_batch if next_batch is not None else next(data_iter)
@@ -483,6 +620,8 @@ class LocalOptimizer(Optimizer):
                         f"divisible by n_micro")
             rng, sub = jax.random.split(rng)
             t0 = time.perf_counter()
+            if watchdog is not None:
+                watchdog.step_started()
             params, buffers, opt_state, loss = self._step_fn(
                 params, buffers, opt_state,
                 jnp.asarray(batch.data), jnp.asarray(batch.labels), sub,
@@ -497,6 +636,8 @@ class LocalOptimizer(Optimizer):
                 # serialized iteration per epoch is the correct price
                 next_batch = next(data_iter)
             loss_val = float(loss)  # syncs; also what the reference logs
+            if watchdog is not None:
+                watchdog.step_finished()
             dt = time.perf_counter() - t0
             bs = batch.data.shape[0]
             records_this_epoch += bs
@@ -515,6 +656,11 @@ class LocalOptimizer(Optimizer):
                 # pass, and any Prefetcher threads in the chain stay live
                 # (rebinding would leak one blocked worker per epoch)
                 self.dataset.shuffle()
+            # kept current EVERY iteration (not just post-loop) so any
+            # checkpoint — scheduled or emergency — records how far into
+            # the epoch training got, and resume_from can fast-forward
+            # the data stream to the exact record
+            self.state["records_processed"] = records_this_epoch
             # publish params so summaries/validation/checkpoint see current
             # weights (and never the buffers donated into the next step)
             self.model.params, self.model.buffers = params, buffers
@@ -529,6 +675,8 @@ class LocalOptimizer(Optimizer):
             self.state["neval"] += 1
             self._maybe_validate()
             wrote_ckpt = self._maybe_checkpoint()
+            if not wrote_ckpt:
+                self._maybe_stall_checkpoint()
             if self._check_preemption():
                 if self.checkpoint_path is not None and not wrote_ckpt:
                     self._checkpoint()

@@ -230,7 +230,30 @@ class DistriOptimizer(Optimizer):
         return preempted
 
     # ------------------------------------------------------------------ #
+    def _publish_for_checkpoint(self) -> None:
+        """Emergency-checkpoint support: gather the live device shards
+        to host so the checkpoint records the last completed step, not
+        the last trigger-published one.  The gather is guarded by the
+        caller (_emergency_checkpoint) — with the backend gone it
+        throws and the checkpoint falls back to the last published
+        host state."""
+        cb = getattr(self, "_live_publish", None)
+        if cb is not None:
+            cb()
+
     def optimize(self) -> Module:
+        try:
+            return self._optimize_impl()
+        except Exception as e:
+            # crash resilience: persist the last completed step before
+            # surfacing the failure, so resume_from loses at most the
+            # in-flight step
+            self._emergency_checkpoint(f"training loop failed: {e!r}")
+            raise
+        finally:
+            self._live_publish = None
+
+    def _optimize_impl(self) -> Module:
         self._init_driver_state()
         if jax.process_count() > 1:
             # publish() runs a cross-process gather, and the triggers that
@@ -285,6 +308,8 @@ class DistriOptimizer(Optimizer):
         self.dataset.shuffle()
         data_iter = self.dataset.data(train=True)
         records_this_epoch = self.state.get("records_processed", 0)
+        self._fast_forward_data(data_iter, records_this_epoch,
+                                scale=jax.process_count())
         wall0 = time.perf_counter()
         # host/device overlap (see LocalOptimizer): fetch + place the
         # NEXT batch between issuing the step and syncing on its loss,
@@ -316,6 +341,16 @@ class DistriOptimizer(Optimizer):
         if env_watchdog_enabled():
             watchdog = shared_watchdog("train_step")
             watchdog.reset(**env_watchdog_kwargs())
+        self._arm_stall_checkpoint(watchdog)
+
+        # emergency-checkpoint gather hook: reads the CURRENT loop
+        # bindings of w_shards/opt_state/buffers (function-scope
+        # variables, so the closure always sees the latest step)
+        def _publish_live():
+            self.model.params = arp.to_pytree(_fetch_to_host(w_shards))
+            self.model.buffers = buffers
+            self.optim_method._state = _fetch_tree_to_host(opt_state)
+        self._live_publish = _publish_live
 
         next_ready = None
         accum_checked = False
@@ -406,6 +441,10 @@ class DistriOptimizer(Optimizer):
                 # reshuffle without rebinding the iterator (keeps Prefetcher
                 # workers alive; the infinite iterator reads the new perm)
                 self.dataset.shuffle()
+            # kept current every iteration so any checkpoint (scheduled,
+            # emergency, stall-escalated) records mid-epoch data progress
+            # for resume_from's fast-forward
+            self.state["records_processed"] = records_this_epoch
             # evaluate each trigger exactly ONCE per iteration (stateful
             # triggers must not be polled twice), then publish gathered
             # weights for validation/checkpoint (the reference's getModel,
@@ -463,6 +502,11 @@ class DistriOptimizer(Optimizer):
                     with tracer.span("train/checkpoint", cat="train",
                                      iteration=self.state["neval"]):
                         self._checkpoint()
+            if not (do_ckpt or preempt_ckpt):
+                # stall-watchdog escalation: checkpoint at the first
+                # completed iteration after a stall fired (the publish
+                # inside _emergency_checkpoint does the gather)
+                self._maybe_stall_checkpoint()
             if preempted:
                 log.warning("stopping on preemption at iteration %d",
                             self.state["neval"] - 1)

@@ -1,0 +1,293 @@
+"""Multi-replica serving failover: N ServingEngine replicas behind ONE
+DynamicBatcher.
+
+The ROADMAP's multi-replica routing item, built as a resilience layer:
+requests ride the familiar submit/predict queue, and each padded batch
+is dispatched to the least-loaded healthy replica.  A replica that
+fails is retried elsewhere (bounded re-dispatch — an accepted request
+is only lost when EVERY replica is gone), and repeated failures open a
+per-replica circuit breaker: an open replica takes no traffic until a
+cooldown passes, then one half-open probe batch decides whether it
+closes (healthy again) or re-opens.  ``close()`` drains gracefully —
+queued work is served, then replicas shut down.
+
+Replica engines are real :class:`ServingEngine` instances built with
+``with_batcher=False`` (one queue for the set — N idle private queues
+would burn N shared-pool slots and split the batching policy), so they
+keep their own compile caches, stagers, and watchdog bracketing.  All
+health accounting is reported via ``stats()`` and the process-wide
+``resilience/*`` obs counters.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from concurrent.futures import Future
+from typing import Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.resilience.errors import BackendLostError, classify_error
+
+log = logging.getLogger("bigdl_tpu.resilience")
+
+HEALTHY = "healthy"
+OPEN = "open"
+HALF_OPEN = "half_open"
+DRAINING = "draining"
+
+
+class _Replica:
+    __slots__ = ("name", "engine", "state", "inflight", "dispatched",
+                 "failures", "consecutive_failures", "opened_at")
+
+    def __init__(self, name: str, engine):
+        self.name = name
+        self.engine = engine
+        self.state = HEALTHY
+        self.inflight = 0
+        self.dispatched = 0
+        self.failures = 0
+        self.consecutive_failures = 0
+        self.opened_at = 0.0
+
+
+class ReplicaSet:
+    """Serve a built module from ``n_replicas`` engines with failover.
+
+    Args:
+        module: a built ``nn.Module``; every replica freezes the same
+            params, so replica-set outputs are exactly the single-engine
+            outputs (the acceptance contract).
+        n_replicas: how many ServingEngine replicas to build.
+        failure_threshold: consecutive failures that open a replica's
+            circuit.
+        cooldown_s: how long an open circuit waits before a half-open
+            probe is allowed.
+        max_redispatch: how many times one batch may be re-dispatched
+            after a failure before the set gives up (default: try every
+            replica once).
+        clock: injectable monotonic clock (tests drive breaker timing).
+        Remaining kwargs mirror :class:`ServingEngine` / DynamicBatcher
+        policy knobs.
+    """
+
+    def __init__(self, module, n_replicas: int = 2, *,
+                 failure_threshold: int = 3,
+                 cooldown_s: float = 5.0,
+                 max_redispatch: Optional[int] = None,
+                 clock=time.monotonic,
+                 input_shape: Optional[tuple] = None,
+                 buckets: Optional[Sequence[int]] = None,
+                 max_batch_size: Optional[int] = None,
+                 max_wait_ms: float = 5.0,
+                 max_queue: int = 256,
+                 dtype="float32",
+                 platform: Optional[str] = None,
+                 use_shared_pool: bool = True,
+                 **engine_kwargs):
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        from bigdl_tpu.obs import get_registry
+        from bigdl_tpu.serving.batcher import DynamicBatcher
+        from bigdl_tpu.serving.engine import ServingEngine
+        from bigdl_tpu.serving.metrics import ServingMetrics
+        from bigdl_tpu.utils.engine import Engine
+
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.max_redispatch = (int(max_redispatch) if max_redispatch
+                               is not None else max(1, n_replicas - 1))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._registry = get_registry()
+        self._replicas = []
+        for i in range(n_replicas):
+            name = f"r{i}"
+            engine = ServingEngine(
+                module, name=name, with_batcher=False,
+                input_shape=input_shape, buckets=buckets,
+                max_batch_size=max_batch_size, dtype=dtype,
+                platform=platform, **engine_kwargs)
+            self._replicas.append(_Replica(name, engine))
+        ref = self._replicas[0].engine
+        # one batching policy for the whole set, published as the
+        # process's serving/* metrics (created after the member engines
+        # so the set owns the names)
+        self.metrics = ServingMetrics().publish_to(self._registry)
+        self.batcher = DynamicBatcher(
+            self._dispatch_batch,
+            max_batch_size=ref.max_batch_size,
+            max_wait_ms=max_wait_ms,
+            max_queue=max_queue,
+            buckets=ref.buckets,
+            metrics=self.metrics,
+            pool=Engine.default_or_create() if use_shared_pool else None)
+        self._closed = False
+        self._publish_open_circuits()
+
+    # ---------------------------------------------------------------- #
+    # health / breaker state machine (all transitions under _lock)     #
+    # ---------------------------------------------------------------- #
+    def _publish_open_circuits(self) -> None:
+        n_open = sum(1 for r in self._replicas
+                     if r.state in (OPEN, HALF_OPEN))
+        self._registry.gauge("resilience/open_circuits").set(n_open)
+
+    def _pick(self, exclude) -> Optional[_Replica]:
+        """A cooled-down open circuit gets one half-open probe batch
+        (even while healthy replicas exist — lost capacity must be able
+        to return); otherwise the least-loaded healthy replica, ties
+        broken by total work dispatched so serial traffic round-robins."""
+        with self._lock:
+            candidates = [r for r in self._replicas
+                          if r.name not in exclude and r.state != DRAINING]
+            pick = None
+            if not any(r.state == HALF_OPEN for r in self._replicas):
+                now = self._clock()
+                for r in candidates:
+                    if (r.state == OPEN
+                            and now - r.opened_at >= self.cooldown_s):
+                        r.state = HALF_OPEN  # one probe in flight at most:
+                        # a second probe needs this one to resolve first
+                        log.info("replica %s: circuit half-open (probe)",
+                                 r.name)
+                        pick = r
+                        break
+            if pick is None:
+                healthy = [r for r in candidates if r.state == HEALTHY]
+                if healthy:
+                    pick = min(healthy,
+                               key=lambda r: (r.inflight, r.dispatched))
+            if pick is not None:
+                pick.inflight += 1
+                pick.dispatched += 1
+            return pick
+
+    def _record_success(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.inflight -= 1
+            rep.consecutive_failures = 0
+            if rep.state in (HALF_OPEN, OPEN):
+                log.info("replica %s: circuit closed (probe succeeded)",
+                         rep.name)
+            if rep.state != DRAINING:
+                rep.state = HEALTHY
+            self._publish_open_circuits()
+
+    def _record_failure(self, rep: _Replica, exc: BaseException) -> None:
+        with self._lock:
+            rep.inflight -= 1
+            rep.failures += 1
+            rep.consecutive_failures += 1
+            was = rep.state
+            if (rep.state == HALF_OPEN
+                    or rep.consecutive_failures >= self.failure_threshold):
+                rep.state = OPEN
+                rep.opened_at = self._clock()
+            if rep.state == OPEN and was != OPEN:
+                log.warning("replica %s: circuit OPEN after %d consecutive "
+                            "failures (%s)", rep.name,
+                            rep.consecutive_failures, exc)
+            self._publish_open_circuits()
+
+    # ---------------------------------------------------------------- #
+    # dispatch                                                         #
+    # ---------------------------------------------------------------- #
+    def _dispatch_batch(self, x_padded: np.ndarray):
+        """Batcher callback: run on the best replica, re-dispatching a
+        failed batch to another (bounded) so an accepted request only
+        fails when the whole set is down."""
+        tried: set = set()
+        redispatches = 0
+        last: Optional[BaseException] = None
+        while True:
+            rep = self._pick(tried)
+            if rep is None:
+                self._registry.counter("resilience/backend_lost").add(1)
+                raise BackendLostError(
+                    f"no serving replica available ({len(tried)} tried, "
+                    f"{redispatches} re-dispatches): {last}") from last
+            try:
+                y = rep.engine._run_batch(x_padded)
+            except Exception as e:  # noqa: BLE001 — classified below
+                self._record_failure(rep, e)
+                if classify_error(e) == "fatal":
+                    # a model/shape bug fails identically on every
+                    # replica: surface it, don't open every circuit
+                    raise
+                last = e
+                tried.add(rep.name)
+                redispatches += 1
+                if redispatches > self.max_redispatch:
+                    self._registry.counter("resilience/backend_lost").add(1)
+                    raise BackendLostError(
+                        f"batch failed on {redispatches} replicas "
+                        f"(re-dispatch bound reached): {e}") from e
+                self._registry.counter("resilience/failovers").add(1)
+                log.warning("replica %s failed a batch, re-dispatching "
+                            "(%d/%d): %s", rep.name, redispatches,
+                            self.max_redispatch, e)
+                continue
+            self._record_success(rep)
+            return y
+
+    # ---------------------------------------------------------------- #
+    # public API (mirrors ServingEngine)                               #
+    # ---------------------------------------------------------------- #
+    def _coerce(self, x, batched: bool) -> np.ndarray:
+        return self._replicas[0].engine._coerce(x, batched)
+
+    def warmup(self, input_shape: Optional[tuple] = None) -> int:
+        """Pre-compile every bucket on every replica; returns the total
+        number of executables compiled."""
+        return sum(r.engine.warmup(input_shape) for r in self._replicas)
+
+    def submit(self, x, *, batched: bool = True) -> Future:
+        if self._closed:
+            from bigdl_tpu.serving.batcher import ServingClosed
+            raise ServingClosed("replica set is closed")
+        return self.batcher.submit(self._coerce(x, batched))
+
+    def predict(self, x, *, timeout: Optional[float] = None) -> np.ndarray:
+        return self.submit(x).result(timeout=timeout)
+
+    def predict_one(self, x, *,
+                    timeout: Optional[float] = None) -> np.ndarray:
+        fut = self.submit(self._coerce(x, batched=False), batched=True)
+        return fut.result(timeout=timeout)[0]
+
+    def stats(self) -> dict:
+        with self._lock:
+            replicas = {
+                r.name: {"state": r.state, "inflight": r.inflight,
+                         "dispatched": r.dispatched,
+                         "failures": r.failures,
+                         "consecutive_failures": r.consecutive_failures}
+                for r in self._replicas}
+        return {
+            "replicas": replicas,
+            "pending": self.batcher.pending(),
+            "buckets": list(self.batcher.buckets),
+            "metrics": self.metrics.snapshot(
+                self._replicas[0].engine.cache.stats()),
+        }
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Graceful drain: stop intake, serve what is queued, then shut
+        the replicas down."""
+        self._closed = True
+        self.batcher.close(timeout=timeout)
+        with self._lock:
+            for r in self._replicas:
+                r.state = DRAINING
+        for r in self._replicas:
+            r.engine.close()
+        self._publish_open_circuits()
+
+    def __enter__(self) -> "ReplicaSet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

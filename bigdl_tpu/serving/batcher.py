@@ -59,6 +59,20 @@ class _Request:
         self.t_enqueue = time.perf_counter()
 
 
+def _safe_resolve(future: Future, *, result=None, exc=None) -> None:
+    """Resolve a future exactly once, tolerating cancellation and the
+    close()-timeout sweep racing a late worker (InvalidStateError)."""
+    if future.cancelled():
+        return
+    try:
+        if exc is not None:
+            future.set_exception(exc)
+        else:
+            future.set_result(result)
+    except Exception:
+        pass  # already resolved by the other side of the race
+
+
 class DynamicBatcher:
     """Gathers requests into bucket-padded batches for ``run_batch``.
 
@@ -91,6 +105,7 @@ class DynamicBatcher:
         self._queue: "deque[_Request]" = deque()
         self._cv = threading.Condition()
         self._stop = False
+        self._inflight: list = []  # requests inside the current dispatch
         self._worker_done = Future()
         if pool is not None:
             # reuse the shared Engine host pool (one long-running slot)
@@ -139,14 +154,27 @@ class DynamicBatcher:
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         """Stop accepting requests, drain what is queued, join the
-        worker."""
+        worker.  GUARANTEE: no accepted request's future is left
+        hanging — if the worker cannot finish the drain inside
+        ``timeout`` (e.g. the device call is wedged against a dead
+        tunnel), every still-unresolved queued AND in-flight future is
+        failed with :class:`ServingClosed` before close returns."""
         with self._cv:
             self._stop = True
             self._cv.notify_all()
         try:
             self._worker_done.result(timeout=timeout)
         except Exception:
-            pass
+            # drain timed out: sweep everything still unresolved.  A
+            # late worker completion races these sets; both sides go
+            # through _safe_resolve, so whichever lands first wins and
+            # the loser is a no-op.
+            with self._cv:
+                leftovers = list(self._queue) + list(self._inflight)
+                self._queue.clear()
+            for r in leftovers:
+                _safe_resolve(r.future, exc=ServingClosed(
+                    "batcher closed before this request was served"))
 
     # ------------------------------------------------------------------ #
     def _loop_guard(self) -> None:
@@ -158,8 +186,12 @@ class DynamicBatcher:
                 leftovers = list(self._queue)
                 self._queue.clear()
             for r in leftovers:
-                r.future.set_exception(ServingClosed("batcher closed"))
-            self._worker_done.set_result(None)
+                _safe_resolve(r.future,
+                              exc=ServingClosed("batcher closed"))
+            try:
+                self._worker_done.set_result(None)
+            except Exception:
+                pass  # a crashed-and-restarted guard already resolved it
 
     def _take_batch(self) -> Optional[list]:
         """Block for the first request, then gather until the batch is
@@ -237,8 +269,7 @@ class DynamicBatcher:
                     off += r.n
         except Exception as e:
             for r in batch:
-                if not r.future.cancelled():
-                    r.future.set_exception(e)
+                _safe_resolve(r.future, exc=e)
             return
         device_s = time.perf_counter() - t_start
         if self._metrics is not None:
@@ -247,8 +278,7 @@ class DynamicBatcher:
                           requests=len(batch), rows=total):
             done = time.perf_counter()
             for r, yr in zip(batch, ys):  # submission order -> response order
-                if not r.future.cancelled():
-                    r.future.set_result(yr)
+                _safe_resolve(r.future, result=yr)
                 if self._metrics is not None:
                     self._metrics.record_done(done - r.t_enqueue)
 
@@ -257,4 +287,10 @@ class DynamicBatcher:
             batch = self._take_batch()
             if batch is None:
                 return
-            self._serve_batch(batch)
+            with self._cv:
+                self._inflight = list(batch)
+            try:
+                self._serve_batch(batch)
+            finally:
+                with self._cv:
+                    self._inflight = []

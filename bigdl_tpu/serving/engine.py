@@ -59,6 +59,12 @@ class ServingEngine:
         donate_x: donate the input buffer to the compiled executable.
         use_shared_pool: run the batching worker on the shared Engine
             host pool instead of a private thread.
+        name: label for traces, metrics, and fault-injection filters
+            (``resilience.ReplicaSet`` names its members r0..rN-1).
+        with_batcher: when False the engine is built WITHOUT its own
+            DynamicBatcher — submit/predict are disabled and batches
+            arrive through ``_run_batch`` from an external dispatcher
+            (the ReplicaSet mode: one queue fronting N engines).
     """
 
     def __init__(self, module, *,
@@ -72,13 +78,16 @@ class ServingEngine:
                  donate_x: bool = False,
                  max_cache_entries: int = 16,
                  chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-                 use_shared_pool: bool = True):
+                 use_shared_pool: bool = True,
+                 name: str = "engine",
+                 with_batcher: bool = True):
         select_platform(platform)
         import jax
         import jax.numpy as jnp
 
         module._built()
         self.module = module
+        self.name = name
         # freeze: the engine holds its own references; later training
         # steps rebind module.params and never touch these
         self._params = module.params
@@ -107,6 +116,11 @@ class ServingEngine:
             raise ValueError(
                 f"largest bucket {max(buckets)} < max_batch_size "
                 f"{max_batch_size}: every dispatch must fit a bucket")
+        # kept on the engine (not just the batcher): batcher-less
+        # replica members still need them for warmup, and an external
+        # dispatcher (ReplicaSet) reads them to configure its own queue
+        self.max_batch_size = int(max_batch_size)
+        self.buckets = tuple(sorted(set(int(b) for b in buckets)))
 
         _rng = jax.random.PRNGKey(0)  # inert: training=False paths
         _module = module
@@ -132,14 +146,16 @@ class ServingEngine:
         self.watchdog = (shared_watchdog("serve_dispatch")
                          .reset(**env_watchdog_kwargs())
                          if env_watchdog_enabled() else None)
-        self.batcher = DynamicBatcher(
-            self._run_batch,
-            max_batch_size=max_batch_size,
-            max_wait_ms=max_wait_ms,
-            max_queue=max_queue,
-            buckets=buckets,
-            metrics=self.metrics,
-            pool=Engine.default_or_create() if use_shared_pool else None)
+        self.batcher = None
+        if with_batcher:
+            self.batcher = DynamicBatcher(
+                self._run_batch,
+                max_batch_size=max_batch_size,
+                max_wait_ms=max_wait_ms,
+                max_queue=max_queue,
+                buckets=buckets,
+                metrics=self.metrics,
+                pool=Engine.default_or_create() if use_shared_pool else None)
         self._closed = False
 
     # ------------------------------------------------------------------ #
@@ -148,6 +164,12 @@ class ServingEngine:
         if self.watchdog is not None:
             self.watchdog.step_started()
         try:
+            # resilience hook: replica death / latency spikes inject
+            # here (filtered by this engine's name), before any device
+            # work — exactly where a dead tunnel would first surface
+            from bigdl_tpu.resilience.faults import fault_point
+            fault_point("serving.dispatch", name=self.name,
+                        rows=int(x_padded.shape[0]))
             misses0 = (self.cache.stats()["misses"] if _tracer.enabled
                        else 0)
             with _tracer.span("serve/h2d", cat="serve",
@@ -188,7 +210,7 @@ class ServingEngine:
             raise ValueError("warmup needs input_shape (none configured "
                              "and no request seen yet)")
         self.input_shape = shape
-        shapes = [(b,) + shape for b in self.batcher.buckets]
+        shapes = [(b,) + shape for b in self.buckets]
         return self.cache.warmup(self._params, self._buffers, shapes,
                                  self._dtype)
 
@@ -198,6 +220,10 @@ class ServingEngine:
         if self._closed:
             from bigdl_tpu.serving.batcher import ServingClosed
             raise ServingClosed("engine is closed")
+        if self.batcher is None:
+            raise RuntimeError(
+                "this engine has no batcher (with_batcher=False): it is "
+                "a ReplicaSet member — submit through the ReplicaSet")
         return self.batcher.submit(self._coerce(x, batched))
 
     def predict(self, x, *, timeout: Optional[float] = None) -> np.ndarray:
@@ -213,8 +239,9 @@ class ServingEngine:
     # ------------------------------------------------------------------ #
     def stats(self) -> dict:
         out = {
-            "pending": self.batcher.pending(),
-            "buckets": list(self.batcher.buckets),
+            "name": self.name,
+            "pending": self.batcher.pending() if self.batcher else 0,
+            "buckets": list(self.buckets),
             "quant_dtype": self.quant_dtype,
             "quant_bytes_staged": self._quant_bytes_staged,
             "compile_cache": self.cache.stats(),
@@ -232,7 +259,8 @@ class ServingEngine:
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         self._closed = True
-        self.batcher.close(timeout=timeout)
+        if self.batcher is not None:
+            self.batcher.close(timeout=timeout)
 
     def __enter__(self) -> "ServingEngine":
         return self

@@ -17,8 +17,11 @@ This module is that contract's single implementation.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Callable
+
+log = logging.getLogger("bigdl_tpu.artifacts")
 
 
 def write_artifact(path: str, result: dict) -> None:
@@ -31,16 +34,23 @@ def write_artifact(path: str, result: dict) -> None:
 
 
 def load_artifact(path: str):
-    """The prior artifact document, or None (missing/unreadable files
-    resume nothing, silently).  Parse ONCE per run: callers indexing
-    several sections must not re-read a file a concurrent runner may be
-    rewriting between reads."""
+    """The prior artifact document, or None.  A MISSING file resumes
+    nothing silently (cold start); an EXISTING-but-unparseable one
+    (truncated by a kill mid-flush on a non-atomic writer, disk
+    corruption) is treated as absent with a loud warning — the sweep
+    restarts instead of crashing the round on a json decode error.
+    Parse ONCE per run: callers indexing several sections must not
+    re-read a file a concurrent runner may be rewriting between
+    reads."""
     if path and os.path.exists(path):
         try:
             with open(path) as f:
                 return json.load(f)
-        except (OSError, ValueError):
-            pass
+        except (OSError, ValueError) as e:
+            log.warning(
+                "artifact %s exists but is unreadable (%s: %s) — "
+                "treating it as absent and restarting the sweep",
+                path, type(e).__name__, e)
     return None
 
 

@@ -247,6 +247,10 @@ class Engine:
         import jax
 
         select_platform(platform)
+        # resilience hook: simulate the classic failure where the
+        # tunneled backend never answers the first jax.devices() touch
+        from bigdl_tpu.resilience.faults import fault_point
+        fault_point("engine.init")
 
         with _state.lock:
             if node_number is None:

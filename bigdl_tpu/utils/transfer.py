@@ -7,18 +7,34 @@ along the leading dim into <=32 MB pieces with exactly one slice in
 flight at a time, then assemble on device.  bench.py carried this
 inline; serving needs it too, so the pattern lives here once.
 
+Resilience (bigdl_tpu.resilience): each slice upload runs under
+``with_backoff`` — a transient relay wobble retries with exponential
+backoff AND halves the chunk size toward an 8 MB floor (a flaky tunnel
+degrades to smaller frames instead of dying), while a lost backend
+surfaces as a classified ``BackendLostError`` after bounded attempts
+instead of the round-4 indefinite hang.
+
 One devicewise concat costs a copy; losing the backend costs the round.
 """
 from __future__ import annotations
+
+from bigdl_tpu.resilience.faults import fault_point
+from bigdl_tpu.resilience.retry import with_backoff
 
 #: Conservative per-transfer ceiling; the relay died somewhere between
 #: 32 MB (fine in round 4) and ~154 MB (fatal).
 DEFAULT_CHUNK_BYTES = 32 << 20
 
+#: Downshift floor: halving below 8 MB buys no more relay safety and
+#: multiplies per-slice dispatch overhead.
+MIN_CHUNK_BYTES = 8 << 20
+
 
 def chunked_device_put(x_host, dtype=None, *,
                        chunk_bytes: int = DEFAULT_CHUNK_BYTES,
-                       device=None):
+                       device=None,
+                       max_retries: int = 4,
+                       min_chunk_bytes: int = MIN_CHUNK_BYTES):
     """Stage ``x_host`` onto the device in <= ``chunk_bytes`` slices
     along the leading dim, one in flight at a time, and return the
     assembled (blocked-until-ready) device array.
@@ -27,6 +43,11 @@ def chunked_device_put(x_host, dtype=None, *,
     host batch uploaded as bf16 moves a quarter of the bytes).  Arrays
     that fit in one chunk take the single device_put fast path; 0-d
     arrays always do.
+
+    A slice that fails transiently retries up to ``max_retries`` times
+    with backoff, halving the working chunk size toward
+    ``min_chunk_bytes`` before each retry; exhausted retries and dead
+    backends raise :class:`~bigdl_tpu.resilience.errors.BackendLostError`.
     """
     import jax.numpy as jnp
     import numpy as np
@@ -45,31 +66,53 @@ def chunked_device_put(x_host, dtype=None, *,
         return arr
 
     if x_host.ndim == 0 or x_host.size == 0:
-        out = _put(x_host)
-        out.block_until_ready()
-        return out
+        def _small():
+            fault_point("transfer.chunk", rows=0, bytes=0)
+            out = _put(x_host)
+            out.block_until_ready()
+            return out
+        return with_backoff(_small, retries=max_retries, label="h2d put")
 
-    per_row = max(1, int(x_host[0:1].size) * jnp.dtype(target).itemsize)
-    rows = max(1, int(chunk_bytes) // per_row)
+    itemsize = jnp.dtype(target).itemsize
+    per_row = max(1, int(x_host[0:1].size) * itemsize)
     n = x_host.shape[0]
-    if rows >= n:
-        out = _put(x_host)
-        out.block_until_ready()
-        return out
+    # mutable so the on_transient hook below downshifts mid-transfer;
+    # later slices keep the reduced size (the relay stays flaky)
+    state = {"chunk": max(int(chunk_bytes), per_row)}
+    floor = max(1, min(int(min_chunk_bytes), state["chunk"]))
+
+    def _downshift(attempt, exc):
+        new = max(floor, state["chunk"] // 2)
+        if new < state["chunk"]:
+            state["chunk"] = new
+            from bigdl_tpu.obs import get_registry
+            get_registry().counter("resilience/transfer_downshifts").add(1)
+            _tr.instant("h2d/downshift", cat="transfer", chunk_bytes=new)
 
     parts = []
-    itemsize = jnp.dtype(target).itemsize
-    for i in range(0, n, rows):
-        piece = x_host[i:i + rows]
-        with _tr.span("h2d/chunk", cat="transfer", offset_rows=i,
-                      rows=int(piece.shape[0]),
-                      bytes=int(piece.size) * itemsize):
-            p = _put(piece)
-            # one in-flight slice at a time — device_put is async, so
-            # building the list without blocking would enqueue every
-            # slice at once, recreating the oversized burst
-            p.block_until_ready()
+    i = 0
+    while i < n:
+        def _stage(i=i):
+            rows = max(1, state["chunk"] // per_row)
+            piece = x_host[i:i + rows]
+            with _tr.span("h2d/chunk", cat="transfer", offset_rows=i,
+                          rows=int(piece.shape[0]),
+                          bytes=int(piece.size) * itemsize):
+                fault_point("transfer.chunk", offset_rows=i,
+                            rows=int(piece.shape[0]),
+                            bytes=int(piece.size) * itemsize)
+                p = _put(piece)
+                # one in-flight slice at a time — device_put is async,
+                # so building the list without blocking would enqueue
+                # every slice at once, recreating the oversized burst
+                p.block_until_ready()
+            return p, int(piece.shape[0])
+        p, took = with_backoff(_stage, retries=max_retries,
+                               on_transient=_downshift, label="h2d chunk")
         parts.append(p)
+        i += took
+    if len(parts) == 1:
+        return parts[0]
     with _tr.span("h2d/assemble", cat="transfer", chunks=len(parts)):
         out = jnp.concatenate(parts, axis=0)
         out.block_until_ready()

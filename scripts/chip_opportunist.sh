@@ -101,9 +101,42 @@ PYEOF
 # driver commits leftovers anyway.
 ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
-PROFILE_TPU.json TUNNEL_STRESS.json \
+PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
 SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
+
+# Relay-failure trace: every dead probe and every mid-stage backend
+# death appends a row here.  This is the empirical fault model the
+# resilience layer's injector specs (BIGDL_TPU_FAULTS) replay in tier-1
+# tests — real incidents in, deterministic chaos out.  Append is atomic
+# (rewrite via temp+rename) and tolerant of a corrupt/truncated file
+# (starts a fresh log rather than dying — the incident recorder must
+# never be the thing that kills the round).
+record_incident() {  # record_incident <stage> <rc>
+  INC_STAGE="$1" INC_RC="$2" python - <<'PYEOF' 2>> "$LOG" || true
+import json, os, time
+path = "TUNNEL_INCIDENTS.json"
+doc = {"tool": "chip_opportunist", "incidents": []}
+try:
+    with open(path) as f:
+        prev = json.load(f)
+    if isinstance(prev, dict) and isinstance(prev.get("incidents"), list):
+        doc = prev
+except Exception:
+    pass  # missing or truncated: fresh log
+doc["incidents"].append({
+    "ts_unix": round(time.time(), 1),
+    "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    "stage": os.environ["INC_STAGE"],
+    "rc": int(os.environ["INC_RC"]),
+})
+tmp = path + ".tmp"
+with open(tmp, "w") as f:
+    json.dump(doc, f, indent=2)
+    f.write("\n")
+os.replace(tmp, path)
+PYEOF
+}
 
 commit_artifacts() {  # commit_artifacts <message>
   local msg="$1" i f existing="" adds_ok
@@ -163,6 +196,9 @@ run_stage() {  # run_stage <name> <artifact> <budget> <cmd...>
     return 0
   fi
   say "stage $name: not done (rc=$rc)"
+  # a stage that fired against a live probe and still died means the
+  # backend was lost mid-stage — feed the fault model
+  record_incident "$name" "$rc"
   return 1
 }
 
@@ -292,6 +328,7 @@ while :; do
     fi
     dead_streak=$((dead_streak + 1))
     say "probe: dead (streak $dead_streak)"
+    record_incident "probe" 1
     sleep 20
   fi
 done

@@ -157,16 +157,30 @@ def run_stage(args, stage, doc, platform):
             # and the artifact write (a wide window: validation + jit run
             # after the flush).  The trained epoch is real — reconstruct
             # its row from the snapshot instead of discarding scarce
-            # window training
-            model = nn.Module.load(found[0])
-            _, res = LocalValidator(model, val_ds).test([Top1Accuracy()])[0]
-            snap = file_io.load(found[1])
-            loss = float((snap.get("driver_state") or {}).get("loss", 0.0))
-            rows.append({"epoch": done_epochs,
-                         "train_loss_last": round(loss, 6),
-                         "val_top1": round(float(res.result()[0]), 6),
-                         "seconds": None, "reconstructed": True})
-            start_epoch = done_epochs
+            # window training.  A checkpoint pair truncated by the same
+            # kill is treated like a corrupt artifact: warn, wipe, and
+            # retrain instead of crashing the whole round on an
+            # unpicklable file
+            try:
+                model = nn.Module.load(found[0])
+                _, res = LocalValidator(model, val_ds).test(
+                    [Top1Accuracy()])[0]
+                snap = file_io.load(found[1])
+                loss = float(
+                    (snap.get("driver_state") or {}).get("loss", 0.0))
+            except Exception as e:
+                print(f"[{stage}] checkpoint {found[0]} unreadable "
+                      f"({type(e).__name__}: {e}) - discarding and "
+                      "restarting the stage", flush=True)
+                rows, start_epoch = [], 0
+                shutil.rmtree(ckpt_dir, ignore_errors=True)
+                found = None
+            else:
+                rows.append({"epoch": done_epochs,
+                             "train_loss_last": round(loss, 6),
+                             "val_top1": round(float(res.result()[0]), 6),
+                             "seconds": None, "reconstructed": True})
+                start_epoch = done_epochs
         else:
             # genuinely inconsistent (wiped workdir, older artifact):
             # the checkpoints are the training state — restart the rows

@@ -8,6 +8,14 @@
 set -u
 cd "$(dirname "$0")/.."
 N=${N:-3}
+
+# Fast resilience gate first (FAULTS_GATE=0 skips): the fault matrix is
+# small and tier-1, and a broken retry/failover/resume path should fail
+# the run in seconds, before the full shards spend their minutes.
+if [ "${FAULTS_GATE:-1}" = "1" ]; then
+  python -m pytest tests/test_resilience.py -q -m faults || exit 1
+fi
+
 files=(tests/test_*.py)
 pids=()
 for i in $(seq 0 $((N - 1))); do

@@ -34,6 +34,10 @@ def pytest_configure(config):
         "markers", "slow: long-running test, skipped unless --full or "
         "BIGDL_TPU_FULL_TESTS=1 (driver windows need the default run "
         "under ~8 minutes; full coverage stays one flag away)")
+    config.addinivalue_line(
+        "markers", "faults: deterministic fault-injection matrix "
+        "(bigdl_tpu.resilience) — fast, tier-1, CPU-only; selectable "
+        "alone via -m faults as the CI resilience gate")
 
 
 def pytest_collection_modifyitems(config, items):

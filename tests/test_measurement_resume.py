@@ -159,3 +159,31 @@ def test_profile_resume_skips_measured_batches(tmp_path):
     # best_preset computed from the reused rows, with its provenance
     assert d["best_preset"]["preset"] == "scoped_vmem_32m"
     assert d["best_preset"]["baseline_source"] == "flag_sweep_baseline"
+
+
+# --------------------------------------------------------------------------- #
+# corrupted resumable artifacts (resilience): treated as absent, loudly       #
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.faults
+def test_corrupt_artifact_treated_as_absent_with_warning(tmp_path, caplog):
+    """A truncated/garbage artifact (kill mid-flush, disk corruption)
+    must restart the sweep with a warning — never crash the round on a
+    JSONDecodeError, never resume from half a document."""
+    import logging
+    from bigdl_tpu.utils.artifacts import load_artifact, write_artifact
+
+    art = tmp_path / "sweep.json"
+    # missing file: silent cold start
+    with caplog.at_level(logging.WARNING, logger="bigdl_tpu.artifacts"):
+        assert load_artifact(str(art)) is None
+    assert not caplog.records
+
+    art.write_text('{"complete": true, "rows": [')  # truncated mid-flush
+    with caplog.at_level(logging.WARNING, logger="bigdl_tpu.artifacts"):
+        assert load_artifact(str(art)) is None
+    assert any("unreadable" in r.message for r in caplog.records)
+
+    # a good artifact still round-trips
+    write_artifact(str(art), {"complete": True, "rows": [{"n": 1}]})
+    assert load_artifact(str(art))["rows"] == [{"n": 1}]

@@ -145,6 +145,33 @@ def test_batcher_close_rejects_new_and_drains_pending():
         b.submit(np.ones((1, 2), np.float32))
 
 
+def test_batcher_close_timeout_resolves_inflight_and_queued():
+    """Regression (resilience): close() against a WEDGED dispatch must
+    not leave any accepted future hanging — queued and in-flight
+    requests all resolve with ServingClosed within the timeout, and the
+    late worker completion afterwards is a harmless no-op."""
+    release = threading.Event()
+    served = []
+
+    def wedged(x):
+        release.wait(20)  # the dead-tunnel stand-in: a stuck device call
+        served.append(x.shape)
+        return x
+
+    b = DynamicBatcher(wedged, max_batch_size=2, max_wait_ms=1)
+    try:
+        futs = [b.submit(np.ones((1, 3), np.float32)) for _ in range(5)]
+        t0 = time.perf_counter()
+        b.close(timeout=0.3)
+        assert time.perf_counter() - t0 < 10.0
+        for f in futs:  # every accepted request resolved, none hang
+            with pytest.raises(ServingClosed):
+                f.result(timeout=5)
+    finally:
+        release.set()  # unwedge; the late result must not blow up
+        time.sleep(0.05)
+
+
 def test_batcher_run_error_propagates_to_futures():
     def run(x):
         raise RuntimeError("device fell over")
