@@ -873,13 +873,265 @@ def _serve_bench(argv) -> int:
                 pass
 
 
+# ---------------------------------------------------------------------------
+# --serve-lm: continuous-batching LM serving benchmark -> BENCH_LM_SERVE.json
+# ---------------------------------------------------------------------------
+
+#: (prompt_len, max_new) menu cycled by the workload RNG — mixed lengths
+#: are the whole point: lockstep batching pads every request to the
+#: slowest one, continuous batching doesn't.
+_LM_PROMPT_LENS = (8, 24, 48)
+_LM_MAX_NEWS = (16, 32, 48)
+
+
+def _lm_workload(n_requests: int, vocab: int, mean_gap_ms: float, rng):
+    """Deterministic staggered-arrival workload: (arrive_at_s, prompt
+    (1-based ids), max_new) per request."""
+    import numpy as np
+    work, at = [], 0.0
+    for _ in range(n_requests):
+        t = _LM_PROMPT_LENS[rng.randint(len(_LM_PROMPT_LENS))]
+        m = _LM_MAX_NEWS[rng.randint(len(_LM_MAX_NEWS))]
+        prompt = rng.randint(1, vocab + 1, size=t).astype(np.int32)
+        work.append((at, prompt, m))
+        at += float(rng.exponential(mean_gap_ms / 1000.0))
+    return work
+
+
+def _serve_lm_stage_continuous(eng, model, work, probes: int) -> dict:
+    """Replay the arrival schedule against the continuous-batching
+    engine; every latency number is measured client-side."""
+    import numpy as np
+    from bigdl_tpu.models.transformer.generate import generate
+
+    t0 = time.perf_counter()
+    streams = []
+    for arrive_at, prompt, max_new in work:
+        lag = arrive_at - (time.perf_counter() - t0)
+        if lag > 0:
+            time.sleep(lag)
+        streams.append(eng.submit(prompt, max_new_tokens=max_new))
+    outs = [s.result(timeout=600) for s in streams]
+    t_end = max(s.finished_at for s in streams)
+    useful = int(sum(len(s.generated) for s in streams))
+    ttfts = [s.ttft_s for s in streams]
+    snap = eng.metrics.snapshot()
+    # bit-exactness probe: a served request IS offline generate at B=1
+    exact = 0
+    for (arrive_at, prompt, max_new), out in list(zip(work, outs))[:probes]:
+        ref = np.asarray(generate(model, model.params,
+                                  prompt[None], max_new))
+        exact += int(np.array_equal(out, ref[0]))
+    span = t_end - t0
+    return {
+        "requests": len(work),
+        "tokens": useful,
+        "duration_s": round(span, 3),
+        "tokens_per_s": round(useful / span, 2),
+        "ttft": _percentiles_ms(ttfts),
+        "itl_p50_ms": (round(snap["itl"]["p50_s"] * 1000.0, 3)
+                       if snap["itl"]["p50_s"] is not None else None),
+        "itl_p99_ms": (round(snap["itl"]["p99_s"] * 1000.0, 3)
+                       if snap["itl"]["p99_s"] is not None else None),
+        "slot_occupancy": (round(snap["slot_occupancy"], 4)
+                           if snap["slot_occupancy"] is not None else None),
+        "agreement_probes": probes,
+        "agreement": round(exact / probes, 4) if probes else None,
+    }
+
+
+def _serve_lm_stage_static(model, work) -> dict:
+    """The lockstep baseline: wait for every arrival, then full-batch
+    ``generate`` per prompt-length group (a static server must pad to a
+    common prompt length and decode to the group's slowest request).
+    Compute is measured; the arrival wait is added arithmetically, so
+    the stage doesn't re-sleep the schedule."""
+    import numpy as np
+    from bigdl_tpu.models.transformer.generate import generate
+
+    groups: dict = {}
+    for arrive_at, prompt, max_new in work:
+        groups.setdefault(len(prompt), []).append((prompt, max_new))
+    last_arrival = max(a for a, _, _ in work)
+    gen_s, useful = 0.0, 0
+    for t, group in sorted(groups.items()):
+        batch = np.stack([p for p, _ in group])
+        m = max(mn for _, mn in group)
+        generate(model, model.params, batch, m)  # warm the (t, m) trace
+        t0 = time.perf_counter()
+        out = np.asarray(generate(model, model.params, batch, m))
+        gen_s += time.perf_counter() - t0
+        assert out.shape == (len(group), t + m)
+        # only each request's OWN budget counts — the lockstep batch
+        # decodes m tokens for everyone, the excess is padding waste
+        useful += sum(mn for _, mn in group)
+    span = last_arrival + gen_s
+    return {
+        "requests": len(work),
+        "groups": len(groups),
+        "tokens": useful,
+        "arrival_wait_s": round(last_arrival, 3),
+        "generate_s": round(gen_s, 3),
+        "duration_s": round(span, 3),
+        "tokens_per_s": round(useful / span, 2),
+        # every token lands when the batch finishes
+        "ttft": _percentiles_ms([span - a for a, _, _ in work]),
+    }
+
+
+def _serve_lm_bench(argv) -> int:
+    """Incremental, resumable LM-serving benchmark -> BENCH_LM_SERVE.json.
+
+    Same artifact contract as --serve: rewrite after every row,
+    ``complete: false`` until the final flush, reuse only rows whose
+    platform + full configuration match."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --serve-lm")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--requests", type=int, default=int(
+        os.environ.get("BIGDL_TPU_SERVE_LM_REQUESTS", "24")))
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--cache-len", type=int, default=128)
+    ap.add_argument("--mean-gap-ms", type=float, default=15.0)
+    ap.add_argument("--probes", type=int, default=2,
+                    help="requests probed for bit-exactness vs offline "
+                         "generate")
+    ap.add_argument("--trace", action="store_true",
+                    help="record obs spans; write TRACE_LM_SERVE.json")
+    args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_LM_SERVE.json")
+
+    from bigdl_tpu.obs import get_tracer
+    if args.trace:
+        get_tracer().enable()
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import jax
+    import numpy as np
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.serving import LMServingEngine
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    config = {"model": "transformer_lm", "vocab": 256, "hidden": 128,
+              "heads": 4, "layers": 4, "max_len": args.cache_len,
+              "pos": "rope", "slots": args.slots,
+              "cache_len": args.cache_len,
+              "requests": args.requests,
+              "mean_gap_ms": args.mean_gap_ms,
+              "prompt_lens": list(_LM_PROMPT_LENS),
+              "max_news": list(_LM_MAX_NEWS)}
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "lm_serving_continuous_batching",
+              "platform": platform,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+    model = TransformerLM(
+        vocab_size=config["vocab"], hidden_size=config["hidden"],
+        n_head=config["heads"], n_layers=config["layers"],
+        max_len=args.cache_len, pos_encoding="rope").build(seed=7)
+    work = _lm_workload(args.requests, config["vocab"],
+                        args.mean_gap_ms, np.random.RandomState(0))
+    eng = LMServingEngine(model, slots=args.slots,
+                          cache_len=args.cache_len,
+                          max_queue=max(args.requests, 256))
+    try:
+        t0 = time.perf_counter()
+        compiled = eng.warmup()
+        rows.append({"stage": "warmup",
+                     "prefill_buckets": list(eng.prefill_buckets),
+                     "prefill_compiled": compiled,
+                     "warmup_s": round(time.perf_counter() - t0, 3)})
+        flush()
+
+        stages = {
+            "continuous": lambda: _serve_lm_stage_continuous(
+                eng, model, work, args.probes),
+            "static_baseline": lambda: _serve_lm_stage_static(model, work),
+        }
+        for name, run in stages.items():
+            if name in prev:
+                row = dict(prev[name])
+                row["reused_from_previous_run"] = True
+            else:
+                row = {"stage": name, **run()}
+                if name == "continuous":
+                    row["prefill_cache"] = eng.prefill_cache.stats()
+            rows.append(row)
+            flush()
+
+        cont = next(r for r in rows if r.get("stage") == "continuous")
+        stat = next(r for r in rows
+                    if r.get("stage") == "static_baseline")
+        speedup = (cont["tokens_per_s"] / stat["tokens_per_s"]
+                   if stat["tokens_per_s"] else None)
+        result["summary"] = {
+            "ttft_p50_ms": cont["ttft"]["p50_ms"],
+            "ttft_p99_ms": cont["ttft"]["p99_ms"],
+            "itl_p50_ms": cont["itl_p50_ms"],
+            "itl_p99_ms": cont["itl_p99_ms"],
+            "tokens_per_s": cont["tokens_per_s"],
+            "slot_occupancy": cont["slot_occupancy"],
+            "agreement": cont["agreement"],
+            "static_tokens_per_s": stat["tokens_per_s"],
+            "static_ttft_p50_ms": stat["ttft"]["p50_ms"],
+            "continuous_speedup": (round(speedup, 3)
+                                   if speedup is not None else None),
+            "continuous_beats_static":
+                bool(speedup and speedup > 1.0),
+        }
+        result["complete"] = True
+        flush()
+        print(json.dumps({
+            "metric": "lm_serving_continuous_tokens_per_sec",
+            "value": cont["tokens_per_s"],
+            "unit": "tokens/sec", "platform": platform,
+            **{k: v for k, v in result["summary"].items()
+               if k != "tokens_per_s"}}), flush=True)
+        return 0
+    finally:
+        eng.close()
+        tr = get_tracer()
+        if tr.enabled:
+            trace_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "TRACE_LM_SERVE.json")
+            try:
+                tr.export_chrome(trace_path)
+                print(f"bench: trace written to {trace_path}",
+                      file=sys.stderr)
+            except OSError:
+                pass
+
+
 if __name__ == "__main__":
-    if "--trace" in sys.argv and "--serve" not in sys.argv:
+    if ("--trace" in sys.argv and "--serve" not in sys.argv
+            and "--serve-lm" not in sys.argv):
         # training bench: the measurement runs in the supervisor's inner
         # subprocess, which inherits env but not argv — hand the flag
         # down as BIGDL_TPU_TRACE and strip it here
         sys.argv = [a for a in sys.argv if a != "--trace"]
         os.environ["BIGDL_TPU_TRACE"] = "1"
+    if "--serve-lm" in sys.argv:
+        sys.exit(_serve_lm_bench(
+            [a for a in sys.argv[1:] if a != "--serve-lm"]))
     if "--serve" in sys.argv:
         sys.exit(_serve_bench([a for a in sys.argv[1:] if a != "--serve"]))
     elif os.environ.get("BIGDL_TPU_BENCH_INNER"):

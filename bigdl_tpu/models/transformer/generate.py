@@ -53,10 +53,15 @@ def _finish_block(model, bp, h, o):
     return h + m
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3))
-def _prefill(model, params, ids0, cache_len):
-    """Run the prompt once; return (hidden-after-all-blocks last position
-    logits, k-cache, v-cache) with caches (L, B, H, cache_len, D)."""
+def _prefill_parts(model, params, ids0, last_index):
+    """Run a (possibly padded) prompt once; return (logits at
+    ``last_index``, k, v) with k/v (L, B, H, T, D) — T the prompt width
+    as given, NOT padded to any cache length (the caller pads for the
+    offline scan, or slot-inserts for serving).  ``last_index`` may be
+    traced: a bucket-padded serving prefill reads the logits at the TRUE
+    prompt end while the padded tail rows stay causally masked (a padded
+    key at position >= last_index+1 is never attended by the query at
+    ``last_index``)."""
     b, t = ids0.shape
     h = params["embed"][ids0]
     if model.pos_encoding == "learned":
@@ -79,39 +84,57 @@ def _prefill(model, params, ids0, cache_len):
             from bigdl_tpu.nn.attention import dot_product_attention
             o = dot_product_attention(q, k, v, causal=True)
         h = _finish_block(model, bp, h, o)
-        pad = cache_len - t
-        kc = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        vc = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
-        return h, (kc, vc)
+        return h, (k, v)
 
-    h, (k_cache, v_cache) = lax.scan(body, h, params["blocks"])
-    h = model._layer_norm(params["ln_f"], h[:, -1:])
+    h, (k, v) = lax.scan(body, h, params["blocks"])
+    h = lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)
+    h = model._layer_norm(params["ln_f"], h)
     head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
             else params["head"].astype(h.dtype))
     logits = (h @ head)[:, 0]
-    return logits.astype(jnp.float32), k_cache, v_cache
+    return logits.astype(jnp.float32), k, v
 
 
-def _decode_step(model, params, token, pos, k_cache, v_cache):
-    """One cached decode step: token (B,) 0-based, pos scalar index of the
-    position being *written*.  Returns (next logits, caches')."""
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _prefill(model, params, ids0, cache_len):
+    """Offline prefill: prompt logits + k/v padded to (L, B, H,
+    cache_len, D), ready for the in-place decode scan."""
+    from bigdl_tpu.quant import dequantize_entry
+    params = dequantize_entry(params)  # int8 clones generate too
+    t = ids0.shape[1]
+    logits, k, v = _prefill_parts(model, params, ids0, t - 1)
+    pad = ((0, 0), (0, 0), (0, 0), (0, cache_len - t), (0, 0))
+    return logits, jnp.pad(k, pad), jnp.pad(v, pad)
+
+
+def _decode_step_slots(model, params, token, pos, k_cache, v_cache):
+    """One cached decode step over S independent *slots*: token (S,)
+    0-based, pos (S,) per-slot index of the position being *written*
+    (slots decode unrelated requests, so each carries its own position).
+    Caches (L, S, H, cache_len, D).  Returns (next logits (S, V) f32,
+    caches').  The serving engine jits this with the caches donated so
+    the decode loop never copies HBM-resident state."""
     mha = model._mha
     h = params["embed"][token][:, None, :]
     if model.pos_encoding == "learned":
-        h = h + lax.dynamic_slice(params["pos"], (pos, 0),
-                                  (1, params["pos"].shape[1]))
-    positions = jnp.reshape(pos, (1,))
+        h = h + params["pos"][pos][:, None, :]
+    # (S, 1, 1): broadcasts against (S, H, 1, half) inside apply_rope —
+    # every slot's key/query rotates at that slot's own position
+    positions = pos[:, None, None]
     cache_len = k_cache.shape[3]
-    # mask over cache positions: attend to <= pos
-    mask = (jnp.arange(cache_len) <= pos)[None, None, None, :]
+    # per-slot mask over cache positions: slot s attends to <= pos[s]
+    mask = (jnp.arange(cache_len)[None, :] <= pos[:, None])[:, None, None, :]
+    # per-slot cache write: dynamic_update_slice needs scalar starts, so
+    # vmap it over the slot axis ((H, C, D) cache rows, scalar position)
+    upd = jax.vmap(lambda c, u, p: lax.dynamic_update_slice(c, u, (0, p, 0)))
 
     def body(carry, layer):
         h = carry
         bp, kc, vc = layer
-        q, k, v = _block_qkv(model, bp, h)  # q,k,v: (B, H, 1, D)
+        q, k, v = _block_qkv(model, bp, h)  # q,k,v: (S, H, 1, D)
         q, k = model._rope(q, k, positions)  # keys rotate at THEIR position
-        kc = lax.dynamic_update_slice(kc, k, (0, 0, pos, 0))
-        vc = lax.dynamic_update_slice(vc, v, (0, 0, pos, 0))
+        kc = upd(kc, k, pos)
+        vc = upd(vc, v, pos)
         scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                             kc.astype(jnp.float32))
         scores = scores / jnp.sqrt(jnp.float32(mha.head_dim))
@@ -130,10 +153,23 @@ def _decode_step(model, params, token, pos, k_cache, v_cache):
     return logits.astype(jnp.float32), k_cache, v_cache
 
 
+def _decode_step(model, params, token, pos, k_cache, v_cache):
+    """One cached decode step for a homogeneous batch: token (B,)
+    0-based, pos scalar index of the position being *written* (one
+    prompt batch decodes in lockstep).  A batch row IS a slot whose
+    position happens to equal every other row's."""
+    b = token.shape[0]
+    return _decode_step_slots(model, params, token,
+                              jnp.full((b,), pos, dtype=jnp.int32),
+                              k_cache, v_cache)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 2))
 def _decode_scan(model, params, max_new, first_token, pos0,
                  k_cache, v_cache, rng, temperature):
     """max_new cached steps under one scan.  first_token is 0-based."""
+    from bigdl_tpu.quant import dequantize_entry
+    params = dequantize_entry(params)
 
     def step(carry, key):
         token, pos, kc, vc = carry

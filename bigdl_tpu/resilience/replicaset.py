@@ -57,10 +57,17 @@ class ReplicaSet:
     """Serve a built module from ``n_replicas`` engines with failover.
 
     Args:
-        module: a built ``nn.Module``; every replica freezes the same
+        module: a built ``nn.Module`` — every replica freezes the same
             params, so replica-set outputs are exactly the single-engine
-            outputs (the acceptance contract).
-        n_replicas: how many ServingEngine replicas to build.
+            outputs (the acceptance contract) — OR a sequence of built
+            modules, one per replica (heterogeneous sets: e.g. a
+            ``Module.quantize()`` int8 clone next to its f32 original;
+            each engine keys its compile cache on its own params dtype).
+            With heterogeneous members the failover contract is
+            per-replica exactness: a request's output is exactly what
+            the replica that served it would produce alone.
+        n_replicas: how many ServingEngine replicas to build (default 2,
+            or ``len(module)`` when a sequence is given).
         failure_threshold: consecutive failures that open a replica's
             circuit.
         cooldown_s: how long an open circuit waits before a half-open
@@ -73,7 +80,7 @@ class ReplicaSet:
         policy knobs.
     """
 
-    def __init__(self, module, n_replicas: int = 2, *,
+    def __init__(self, module, n_replicas: Optional[int] = None, *,
                  failure_threshold: int = 3,
                  cooldown_s: float = 5.0,
                  max_redispatch: Optional[int] = None,
@@ -87,6 +94,17 @@ class ReplicaSet:
                  platform: Optional[str] = None,
                  use_shared_pool: bool = True,
                  **engine_kwargs):
+        modules = (list(module) if isinstance(module, (list, tuple))
+                   else None)
+        if modules is not None:
+            if n_replicas is None:
+                n_replicas = len(modules)
+            elif n_replicas != len(modules):
+                raise ValueError(
+                    f"{len(modules)} modules given but n_replicas="
+                    f"{n_replicas}: pass one module per replica")
+        elif n_replicas is None:
+            n_replicas = 2
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
         from bigdl_tpu.obs import get_registry
@@ -106,7 +124,8 @@ class ReplicaSet:
         for i in range(n_replicas):
             name = f"r{i}"
             engine = ServingEngine(
-                module, name=name, with_batcher=False,
+                modules[i] if modules is not None else module,
+                name=name, with_batcher=False,
                 input_shape=input_shape, buckets=buckets,
                 max_batch_size=max_batch_size, dtype=dtype,
                 platform=platform, **engine_kwargs)

@@ -49,6 +49,32 @@ def power_of_two_buckets(max_batch_size: int) -> tuple:
     return tuple(buckets)
 
 
+def _tree_np(y):
+    """Pull a model output — a single array or any pytree of arrays
+    (multi-headed models, Tables) — to host numpy, leaf-wise."""
+    if hasattr(y, "shape"):
+        return np.asarray(y)
+    import jax
+    return jax.tree_util.tree_map(np.asarray, y)
+
+
+def _tree_slice(y, lo: int, hi: int):
+    """Row-slice every leaf: the per-request slice-back."""
+    if hasattr(y, "shape"):
+        return y[lo:hi]
+    import jax
+    return jax.tree_util.tree_map(lambda a: a[lo:hi], y)
+
+
+def _tree_concat(parts: list):
+    """Concatenate chunked outputs leaf-wise along the batch dim."""
+    if hasattr(parts[0], "shape"):
+        return np.concatenate(parts, 0)
+    import jax
+    return jax.tree_util.tree_map(
+        lambda *leaves: np.concatenate(leaves, 0), *parts)
+
+
 class _Request:
     __slots__ = ("x", "n", "future", "t_enqueue")
 
@@ -78,7 +104,10 @@ class DynamicBatcher:
 
     ``run_batch(x_padded) -> y_padded`` sees only bucket-shaped arrays
     (leading dim in ``buckets``); the batcher pads with zero rows and
-    slices the per-request outputs back out.  A single request larger
+    slices the per-request outputs back out.  The output may be a
+    single array or any pytree of arrays (multi-headed models, Tables)
+    whose every leaf carries the batch dim first — slice-back and
+    oversized-chunk reassembly are leaf-wise.  A single request larger
     than ``max_batch_size`` is served alone, chunked into
     ``max_batch_size`` slices (each slice still bucket-shaped).
     """
@@ -252,20 +281,20 @@ class DynamicBatcher:
                 for i in range(0, req.n, self._max_batch):
                     piece = req.x[i:i + self._max_batch]
                     b = self.bucket_for(int(piece.shape[0]))
-                    y = np.asarray(self._dispatch([piece], b))
-                    outs.append(y[: int(piece.shape[0])])
-                result = np.concatenate(outs, 0)
+                    y = _tree_np(self._dispatch([piece], b))
+                    outs.append(_tree_slice(y, 0, int(piece.shape[0])))
+                result = _tree_concat(outs)
                 bucket_rows = sum(
                     self.bucket_for(min(self._max_batch, req.n - i))
                     for i in range(0, req.n, self._max_batch))
                 ys = [result]
             else:
                 bucket_rows = self.bucket_for(total)
-                y = np.asarray(self._dispatch([r.x for r in batch],
-                                              bucket_rows))
+                y = _tree_np(self._dispatch([r.x for r in batch],
+                                            bucket_rows))
                 ys, off = [], 0
                 for r in batch:
-                    ys.append(y[off:off + r.n])
+                    ys.append(_tree_slice(y, off, off + r.n))
                     off += r.n
         except Exception as e:
             for r in batch:

@@ -27,7 +27,23 @@ import threading
 from collections import OrderedDict
 from typing import Callable, Sequence, Tuple
 
-Key = Tuple[tuple, str, tuple, str]
+Key = Tuple[tuple, tuple, str]
+
+
+def input_signature(x) -> tuple:
+    """The cache-key component for one input: ``(shape, dtype)`` for a
+    single array (the classic batcher case), or, for a multi-tensor /
+    pytree input (the LM prefill case: ids + true length), the treedef
+    plus a tuple of per-leaf ``(shape, dtype)`` — two containers with
+    identical leaves but different structure must not share an
+    executable."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return (tuple(x.shape), str(x.dtype))
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(x)
+    return (str(treedef),
+            tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
 
 
 class CompileCache:
@@ -37,7 +53,9 @@ class CompileCache:
     call — their shapes are part of the trace but not of the key, with
     one exception: their quant dtype tag IS keyed, so a caller serving
     f32 and int8 replicas of one model gets one executable each);
-    ``x`` is the padded batch whose (shape, dtype) keys the entry.
+    ``x`` is the padded batch — a single array or any pytree of arrays
+    (``input_signature``) — whose per-leaf (shape, dtype) keys the
+    entry.
     """
 
     def __init__(self, fn: Callable, *, max_entries: int = 16,
@@ -58,11 +76,27 @@ class CompileCache:
     # ------------------------------------------------------------------ #
     def key_for(self, x, params=None) -> Key:
         from bigdl_tpu.quant import params_dtype_tag
-        return (tuple(x.shape), str(x.dtype), self._donate,
+        return (input_signature(x), self._donate,
                 params_dtype_tag(params) if params is not None else "f32")
 
     def _compile(self, params, buffers, x) -> Callable:
         return self._jit.lower(params, buffers, x).compile()
+
+    def _admit(self, key: Key, entry: Callable, *, count: bool) -> bool:
+        """Insert a freshly compiled entry under the LRU bound; returns
+        whether it was new.  ``count`` toggles the miss counter (warmup
+        provisioning is not traffic)."""
+        with self._lock:
+            if count:
+                self.misses += 1
+            new = key not in self._entries
+            if new:
+                self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._max_entries:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+            return new
 
     def __call__(self, params, buffers, x):
         """Run ``fn`` through the cached executable for x's shape
@@ -77,40 +111,35 @@ class CompileCache:
             # compile outside the lock: a 20s XLA compile must not
             # stall concurrent lookups for already-warm buckets
             entry = self._compile(params, buffers, x)
-            with self._lock:
-                self.misses += 1
-                self._entries[key] = entry
-                self._entries.move_to_end(key)
-                while len(self._entries) > self._max_entries:
-                    self._entries.popitem(last=False)
-                    self.evictions += 1
+            self._admit(key, entry, count=True)
         return entry(params, buffers, x)
 
     # ------------------------------------------------------------------ #
     def warmup(self, params, buffers, shapes: Sequence[tuple],
                dtype) -> int:
-        """Pre-compile an executable per shape; returns how many were
-        newly compiled.  Warmup counts neither hits nor misses — the
-        hit-rate metric describes traffic, not provisioning."""
+        """Pre-compile an executable per (single-array) shape; returns
+        how many were newly compiled.  Warmup counts neither hits nor
+        misses — the hit-rate metric describes traffic, not
+        provisioning."""
         import jax.numpy as jnp
 
+        return self.warmup_inputs(
+            params, buffers, [jnp.zeros(shape, dtype) for shape in shapes])
+
+    def warmup_inputs(self, params, buffers, inputs: Sequence) -> int:
+        """Pre-compile an executable per example input (each a single
+        array or pytree — the multi-tensor analog of ``warmup``);
+        returns how many were newly compiled."""
         compiled = 0
-        for shape in shapes:
-            x = jnp.zeros(shape, dtype)
+        for x in inputs:
             key = self.key_for(x, params)
             with self._lock:
                 present = key in self._entries
             if present:
                 continue
-            entry = self._compile(params, buffers, x)
-            with self._lock:
-                if key not in self._entries:
-                    self._entries[key] = entry
-                    self._entries.move_to_end(key)
-                    compiled += 1
-                    while len(self._entries) > self._max_entries:
-                        self._entries.popitem(last=False)
-                        self.evictions += 1
+            if self._admit(key, self._compile(params, buffers, x),
+                           count=False):
+                compiled += 1
         return compiled
 
     # ------------------------------------------------------------------ #

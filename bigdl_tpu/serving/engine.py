@@ -16,8 +16,9 @@ model), with
 - ``metrics.ServingMetrics`` splitting latency into queue wait vs
   device time, exportable through the visualization tfevents writers.
 
-The served model's output must be a single array with a leading batch
-dim (multi-output pytree routing is a ROADMAP follow-on).
+The served model's output may be a single array or any pytree of
+arrays (multi-headed models, Tables); every leaf must carry the batch
+dim first — the batcher slices requests back out leaf-wise.
 """
 from __future__ import annotations
 
@@ -181,12 +182,22 @@ class ServingEngine:
                 _tracer.instant(
                     "serve/cache_miss" if miss else "serve/cache_hit",
                     cat="serve", bucket=int(x_padded.shape[0]))
-            if not hasattr(y, "shape"):
-                raise TypeError(
-                    f"ServingEngine requires a single-array model output "
-                    f"with a leading batch dim; got {type(y).__name__} "
-                    "(pytree outputs are a ROADMAP follow-on)")
-            return np.asarray(y)  # host pull doubles as the device sync
+            # single array or pytree of arrays — every leaf must carry
+            # the batch dim first or the batcher's slice-back would
+            # silently hand requests the wrong rows
+            import jax
+            rows = int(x_padded.shape[0])
+            leaves = jax.tree_util.tree_leaves(y)
+            if not leaves:
+                raise TypeError("model output has no array leaves")
+            for leaf in leaves:
+                if not hasattr(leaf, "shape") or leaf.ndim < 1 \
+                        or int(leaf.shape[0]) != rows:
+                    raise TypeError(
+                        f"every output leaf needs a leading batch dim of "
+                        f"{rows}; got {getattr(leaf, 'shape', type(leaf))}")
+            # host pull doubles as the device sync
+            return jax.tree_util.tree_map(np.asarray, y)
         finally:
             if self.watchdog is not None:
                 self.watchdog.step_finished()
@@ -234,7 +245,11 @@ class ServingEngine:
                     timeout: Optional[float] = None) -> np.ndarray:
         """Sync single example: adds and strips the batch dim."""
         fut = self.submit(self._coerce(x, batched=False), batched=True)
-        return fut.result(timeout=timeout)[0]
+        y = fut.result(timeout=timeout)
+        if hasattr(y, "shape"):
+            return y[0]
+        import jax
+        return jax.tree_util.tree_map(lambda a: a[0], y)
 
     # ------------------------------------------------------------------ #
     def stats(self) -> dict:

@@ -1,0 +1,707 @@
+"""LM serving: continuous batching over an HBM-resident KV cache.
+
+Offline ``generate()`` decodes one homogeneous batch in lockstep: every
+prompt prefills together, every row steps together, and the batch
+finishes when the SLOWEST request does — a serving workload with
+staggered arrivals and mixed lengths wastes most of its FLOPs on
+padding and waiting.  ``LMServingEngine`` is the iteration-level
+(continuous) batching alternative (Orca, OSDI'22; the throughput model
+vLLM popularized), built from three device programs that all reuse the
+slot-aware kernels in ``models/transformer/generate.py``:
+
+- **prefill** — one bucketed pass per new request: the prompt is padded
+  to a power-of-two length bucket and run through an AOT-compiled
+  executable from the shared :class:`CompileCache` (keyed on the
+  pytree signature ``{ids, len}`` + params quant dtype), producing the
+  first-token logits (read at the TRUE prompt end under the causal
+  mask) and the prompt's k/v rows.
+- **insert** — ``dynamic_update_slice`` of those k/v rows into a free
+  slot of the resident (L, S, H, cache_len, D) caches, between decode
+  iterations.  Donated: insert rewrites the resident buffers in place.
+- **decode** — ONE fixed-shape executable stepping all S slots, each at
+  its own position (per-slot RoPE/positions, per-slot causal mask),
+  with ``donate_argnums`` on both caches so the decode loop never
+  copies HBM-resident state.  Tokens stream back through per-request
+  :class:`LMStream` handles; EOS / max_new early-exit frees the slot
+  for the admission queue the same iteration.
+
+Correctness: a slot's token stream is the same computation offline
+``generate()`` runs at batch 1 — padded prefill reads logits at the
+true last index (causal masking keeps padded keys invisible), decode
+masks cache positions ``> pos`` so stale rows from a previous occupant
+are overwritten before they are ever attended.  The mixed-length soak
+test asserts token-exact agreement per request.
+
+Observability: TTFT and inter-token-latency histograms, tokens/sec
+(sliding window), slot occupancy — published as ``serving/lm/*`` in the
+process-wide registry — plus tracer spans for prefill/insert/decode.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from bigdl_tpu.obs import get_registry, get_tracer
+from bigdl_tpu.obs.registry import FnGauge, Histogram
+from bigdl_tpu.serving.batcher import ServingClosed, ServingQueueFull
+from bigdl_tpu.serving.compile_cache import CompileCache
+from bigdl_tpu.utils.engine import select_platform
+
+_tracer = get_tracer()
+
+
+def prefill_bucket_lengths(max_len: int, min_bucket: int = 8) -> tuple:
+    """Power-of-two prompt-length buckets up to (and including) a
+    non-power-of-two ``max_len`` cap."""
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    buckets = []
+    b = max(1, int(min_bucket))
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(int(max_len))
+    return tuple(sorted(set(buckets)))
+
+
+# ---------------------------------------------------------------------- #
+class LMStream:
+    """Per-request handle: tokens stream in as the engine decodes them.
+
+    ``tokens()`` iterates 1-based generated ids as they land;
+    ``result()`` blocks for the full sequence (prompt + generated).
+    Timing marks (submit / first token / finish) feed the TTFT and
+    inter-token-latency metrics and are readable per request.
+    """
+
+    def __init__(self, prompt_1b: np.ndarray, max_new: int):
+        self.prompt = prompt_1b
+        self.max_new = int(max_new)
+        self._tokens: List[int] = []
+        self._cond = threading.Condition()
+        self._done = False
+        self._error: Optional[BaseException] = None
+        self.submitted_at = time.perf_counter()
+        self.first_token_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+
+    # engine-side ------------------------------------------------------- #
+    def _emit(self, token_1b: int) -> None:
+        with self._cond:
+            if self.first_token_at is None:
+                self.first_token_at = time.perf_counter()
+            self._tokens.append(int(token_1b))
+            self._cond.notify_all()
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        with self._cond:
+            if self._done:
+                return
+            self._done = True
+            self._error = error
+            self.finished_at = time.perf_counter()
+            self._cond.notify_all()
+
+    # client-side ------------------------------------------------------- #
+    def tokens(self, timeout: Optional[float] = None):
+        """Yield generated 1-based token ids as they arrive."""
+        deadline = (time.perf_counter() + timeout) if timeout else None
+        i = 0
+        while True:
+            with self._cond:
+                while len(self._tokens) <= i and not self._done:
+                    left = (deadline - time.perf_counter()) if deadline \
+                        else None
+                    if left is not None and left <= 0:
+                        raise TimeoutError("LMStream.tokens timed out")
+                    self._cond.wait(left)
+                if len(self._tokens) > i:
+                    tok = self._tokens[i]
+                    i += 1
+                elif self._error is not None:
+                    raise self._error
+                else:
+                    return
+            yield tok
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until done; return prompt + generated ids (1-based)."""
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._done, timeout):
+                raise TimeoutError("LMStream.result timed out")
+            if self._error is not None:
+                raise self._error
+            gen = np.asarray(self._tokens, np.int32)
+        return np.concatenate([self.prompt, gen])
+
+    def done(self) -> bool:
+        with self._cond:
+            return self._done
+
+    @property
+    def generated(self) -> np.ndarray:
+        with self._cond:
+            return np.asarray(self._tokens, np.int32)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+
+# ---------------------------------------------------------------------- #
+class LMMetrics:
+    """Serving-LM counters; thread-safe (decode worker + callers).
+
+    Occupancy is measured where continuous batching earns its keep: the
+    fraction of slot-iterations that decoded a real request (a lockstep
+    engine pays for every slot every step regardless)."""
+
+    def __init__(self, slots: int, throughput_window_s: float = 60.0):
+        self._lock = threading.Lock()
+        self.slots = int(slots)
+        self.ttft = Histogram()
+        self.itl = Histogram()
+        self.requests = 0
+        self.rejected = 0
+        self.completed = 0
+        self.tokens = 0
+        self.prefills = 0
+        self.decode_steps = 0
+        self.slot_steps = 0
+        self.active_slot_steps = 0
+        self.started_at = time.perf_counter()
+        self._window_s = float(throughput_window_s)
+        self._recent: deque = deque()  # (t, n_tokens) per decode step
+
+    def publish_to(self, registry,
+                   prefix: str = "serving/lm/") -> "LMMetrics":
+        registry.register(prefix + "ttft", self.ttft, replace=True)
+        registry.register(prefix + "itl", self.itl, replace=True)
+        for key in ("requests", "rejected", "completed", "tokens",
+                    "prefills", "decode_steps"):
+            registry.register(prefix + key,
+                              FnGauge(lambda k=key: getattr(self, k)),
+                              replace=True)
+        registry.register(prefix + "tokens_per_s",
+                          FnGauge(lambda: self.snapshot()["tokens_per_s"]),
+                          replace=True)
+        registry.register(
+            prefix + "slot_occupancy",
+            FnGauge(lambda: self.snapshot()["slot_occupancy"]),
+            replace=True)
+        return self
+
+    # -- recording ------------------------------------------------------ #
+    def record_submit(self) -> None:
+        with self._lock:
+            self.requests += 1
+
+    def record_reject(self) -> None:
+        with self._lock:
+            self.rejected += 1
+
+    def record_first_token(self, ttft_s: float) -> None:
+        with self._lock:
+            self.prefills += 1
+            self.tokens += 1
+            self.ttft.observe(ttft_s)
+            self._recent.append((time.perf_counter(), 1))
+
+    def record_step(self, n_active: int, itls_s: Sequence[float]) -> None:
+        with self._lock:
+            now = time.perf_counter()
+            self.decode_steps += 1
+            self.slot_steps += self.slots
+            self.active_slot_steps += n_active
+            self.tokens += len(itls_s)
+            self._recent.append((now, len(itls_s)))
+            horizon = now - self._window_s
+            while self._recent and self._recent[0][0] < horizon:
+                self._recent.popleft()
+            for itl in itls_s:
+                self.itl.observe(itl)
+
+    def record_complete(self) -> None:
+        with self._lock:
+            self.completed += 1
+
+    # -- reading -------------------------------------------------------- #
+    def snapshot(self) -> dict:
+        with self._lock:
+            now = time.perf_counter()
+            horizon = now - self._window_s
+            while self._recent and self._recent[0][0] < horizon:
+                self._recent.popleft()
+            span = min(now - self.started_at, self._window_s)
+            windowed = sum(n for _, n in self._recent)
+            return {
+                "requests": self.requests,
+                "rejected": self.rejected,
+                "completed": self.completed,
+                "tokens": self.tokens,
+                "prefills": self.prefills,
+                "decode_steps": self.decode_steps,
+                "tokens_per_s": (windowed / span) if span > 0 else 0.0,
+                "slot_occupancy":
+                    (self.active_slot_steps / self.slot_steps)
+                    if self.slot_steps else None,
+                "ttft": self.ttft.snapshot(),
+                "itl": self.itl.snapshot(),
+            }
+
+
+# ---------------------------------------------------------------------- #
+class _Request:
+    __slots__ = ("stream", "prompt0", "max_new", "temperature", "eos0",
+                 "first_key", "step_keys")
+
+    def __init__(self, stream, prompt0, max_new, temperature, eos0,
+                 first_key, step_keys):
+        self.stream = stream
+        self.prompt0 = prompt0          # (t,) int32, 0-based
+        self.max_new = max_new
+        self.temperature = temperature
+        self.eos0 = eos0                # 0-based eos id or None
+        self.first_key = first_key      # np (2,) uint32 or None
+        self.step_keys = step_keys      # np (max_new-1, 2) or None
+
+
+class _Slot:
+    __slots__ = ("stream", "pos_next", "last0", "remaining", "step_idx",
+                 "temperature", "eos0", "step_keys", "last_emit_at")
+
+    def __init__(self, req: _Request, prompt_len: int, first0: int):
+        self.stream = req.stream
+        self.pos_next = prompt_len      # next cache position to write
+        self.last0 = first0             # last emitted token, 0-based
+        self.remaining = req.max_new - 1
+        self.step_idx = 0               # index into step_keys
+        self.temperature = req.temperature
+        self.eos0 = req.eos0
+        self.step_keys = req.step_keys
+        self.last_emit_at = time.perf_counter()
+
+
+# ---------------------------------------------------------------------- #
+class LMServingEngine:
+    """Serve ``TransformerLM`` generation with continuous batching.
+
+    Args:
+        model: a built ``TransformerLM`` (params are frozen at
+            construction, like :class:`ServingEngine`).
+        slots: decode batch width S — concurrent in-flight requests.
+        cache_len: per-slot KV length (default ``model.max_len``);
+            every request needs ``prompt_len + max_new <= cache_len``.
+        max_new_tokens: default generation budget per request.
+        prefill_buckets: prompt-length pad buckets (default powers of
+            two up to ``cache_len``); one AOT prefill executable each.
+        temperature: default sampling temperature (0 = greedy, the
+            bit-exact-vs-offline path).
+        eos_id: default 1-based stop token; generation also stops at
+            ``max_new``.
+        max_queue: admission queue bound (``ServingQueueFull`` beyond).
+        platform: optional jax platform pin.
+        donate_cache: donate k/v into decode/insert (the no-copy hot
+            path); disable only for debugging.
+    """
+
+    def __init__(self, model, *,
+                 slots: int = 8,
+                 cache_len: Optional[int] = None,
+                 max_new_tokens: int = 32,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 temperature: float = 0.0,
+                 eos_id: Optional[int] = None,
+                 max_queue: int = 256,
+                 max_cache_entries: int = 16,
+                 platform: Optional[str] = None,
+                 donate_cache: bool = True,
+                 name: str = "lm"):
+        select_platform(platform)
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        from bigdl_tpu.models.transformer.generate import (
+            _decode_step_slots, _prefill_parts)
+        from bigdl_tpu.quant import dequantize_entry
+
+        model._built()
+        self.model = model
+        self.name = name
+        self._params = model.params
+        self._buffers = model.buffers
+        self.slots = int(slots)
+        self.cache_len = int(cache_len or model.max_len)
+        if self.cache_len > model.max_len:
+            raise ValueError(
+                f"cache_len ({self.cache_len}) exceeds model.max_len "
+                f"({model.max_len})")
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.eos_id = eos_id
+        self._max_queue = int(max_queue)
+
+        if prefill_buckets is None:
+            prefill_buckets = prefill_bucket_lengths(self.cache_len)
+        self.prefill_buckets = tuple(sorted(set(
+            int(b) for b in prefill_buckets)))
+        if self.prefill_buckets[-1] > self.cache_len:
+            raise ValueError(
+                f"largest prefill bucket ({self.prefill_buckets[-1]}) "
+                f"exceeds cache_len ({self.cache_len}): inserted rows "
+                "must fit the slot cache")
+
+        L, H, D = model.n_layers, model._mha.n_head, model._mha.head_dim
+        dt = self._params["embed"].dtype
+        self._kv_shape = (L, self.slots, H, self.cache_len, D)
+        self._k = jnp.zeros(self._kv_shape, dt)
+        self._v = jnp.zeros(self._kv_shape, dt)
+        self._cache_dtype = dt
+
+        # -- the three device programs --------------------------------- #
+        def _prefill_fn(params, buffers, x):
+            del buffers  # part of the CompileCache signature only
+            return _prefill_parts(model, dequantize_entry(params),
+                                  x["ids"], x["len"] - 1)
+
+        self.prefill_cache = CompileCache(
+            _prefill_fn, max_entries=max_cache_entries)
+
+        def _decode_fn(params, token, pos, kc, vc):
+            return _decode_step_slots(model, dequantize_entry(params),
+                                      token, pos, kc, vc)
+
+        donate = (3, 4) if donate_cache else ()
+        self._decode_jit = jax.jit(_decode_fn, donate_argnums=donate)
+        self._decode_exec = None
+
+        def _insert_fn(kc, vc, k_new, v_new, slot):
+            kc = lax.dynamic_update_slice(
+                kc, k_new.astype(kc.dtype), (0, slot, 0, 0, 0))
+            vc = lax.dynamic_update_slice(
+                vc, v_new.astype(vc.dtype), (0, slot, 0, 0, 0))
+            return kc, vc
+
+        self._insert_jit = jax.jit(
+            _insert_fn, donate_argnums=(0, 1) if donate_cache else ())
+        self._insert_execs: dict = {}
+
+        self.metrics = LMMetrics(self.slots).publish_to(get_registry())
+
+        # -- scheduler state (worker thread owns the slots) ------------- #
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._free = list(range(self.slots))
+        self._slots: List[Optional[_Slot]] = [None] * self.slots
+        self._n_active = 0
+        self._closing = False
+        self._abort = False
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name=f"lm-serve-{name}")
+        self._worker.start()
+
+    # ------------------------------------------------------------------ #
+    def warmup(self) -> int:
+        """AOT-compile every prefill bucket plus the decode and insert
+        executables before traffic; returns the number of prefill
+        executables compiled.  Warmup never executes on the resident
+        caches (it lowers against shapes), so it is safe mid-traffic."""
+        import numpy as _np
+
+        inputs = [{"ids": _np.zeros((1, b), _np.int32),
+                   "len": _np.int32(b)} for b in self.prefill_buckets]
+        n = self.prefill_cache.warmup_inputs(
+            self._params, self._buffers, inputs)
+        self._decode_compiled()
+        for b in self.prefill_buckets:
+            self._insert_compiled(b)
+        return n
+
+    def _decode_compiled(self):
+        if self._decode_exec is None:
+            tok = np.zeros((self.slots,), np.int32)
+            pos = np.zeros((self.slots,), np.int32)
+            self._decode_exec = self._decode_jit.lower(
+                self._params, tok, pos, self._k, self._v).compile()
+        return self._decode_exec
+
+    def _insert_compiled(self, bucket: int):
+        exe = self._insert_execs.get(bucket)
+        if exe is None:
+            import jax
+            L, S, H, C, D = self._kv_shape
+            sds = jax.ShapeDtypeStruct
+            new = sds((L, 1, H, bucket, D), self._cache_dtype)
+            exe = self._insert_jit.lower(
+                sds(self._kv_shape, self._cache_dtype),
+                sds(self._kv_shape, self._cache_dtype),
+                new, new, np.int32(0)).compile()
+            self._insert_execs[bucket] = exe
+        return exe
+
+    # ------------------------------------------------------------------ #
+    def bucket_for(self, prompt_len: int) -> int:
+        """Smallest configured prefill bucket >= prompt_len."""
+        for b in self.prefill_buckets:
+            if b >= prompt_len:
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest prefill "
+            f"bucket ({self.prefill_buckets[-1]}); paged prefill for "
+            "over-length prompts is a ROADMAP follow-on")
+
+    def submit(self, prompt_ids, *,
+               max_new_tokens: Optional[int] = None,
+               temperature: Optional[float] = None,
+               eos_id: Optional[int] = None,
+               rng=None) -> LMStream:
+        """Enqueue one prompt ((t,) or (1, t), 1-based ids); returns an
+        :class:`LMStream` of its continuation."""
+        prompt = np.asarray(prompt_ids).reshape(-1).astype(np.int32)
+        t = prompt.shape[0]
+        if t == 0:
+            raise ValueError("empty prompt")
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.max_new_tokens)
+        if max_new <= 0:
+            raise ValueError(f"max_new_tokens must be >= 1, got {max_new}")
+        if t + max_new > self.cache_len:
+            raise ValueError(
+                f"prompt ({t}) + max_new ({max_new}) exceeds cache_len "
+                f"({self.cache_len})")
+        self.bucket_for(t)  # validates now, not at admit time
+        temp = float(self.temperature if temperature is None
+                     else temperature)
+        eos = eos_id if eos_id is not None else self.eos_id
+        eos0 = (int(eos) - 1) if eos is not None else None
+
+        first_key = step_keys = None
+        if temp > 0.0:
+            # replicate offline generate()'s key chain exactly: one
+            # split for the first token, then max_new-1 scan keys
+            import jax
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            elif isinstance(rng, int):
+                rng = jax.random.PRNGKey(rng)
+            rng, sub = jax.random.split(rng)
+            first_key = np.asarray(sub)
+            if max_new > 1:
+                step_keys = np.asarray(jax.random.split(rng, max_new - 1))
+
+        stream = LMStream(prompt, max_new)
+        req = _Request(stream, prompt - 1, max_new, temp, eos0,
+                       first_key, step_keys)
+        with self._cv:
+            if self._closing:
+                raise ServingClosed("LMServingEngine is closed")
+            if len(self._queue) >= self._max_queue:
+                self.metrics.record_reject()
+                raise ServingQueueFull(
+                    f"admission queue full ({self._max_queue})")
+            self._queue.append(req)
+            self._cv.notify_all()
+        self.metrics.record_submit()
+        return stream
+
+    def generate(self, prompt_ids, *,
+                 timeout: Optional[float] = None, **kw) -> np.ndarray:
+        """Sync convenience: submit + wait; returns (t + generated,)
+        1-based ids for one prompt."""
+        return self.submit(prompt_ids, **kw).result(timeout=timeout)
+
+    # -- sampling (host-side, replicating offline generate exactly) ---- #
+    @staticmethod
+    def _pick(logits_row: np.ndarray, temperature: float, key,
+              clamp: bool) -> int:
+        if temperature <= 0.0 or key is None:
+            return int(np.argmax(logits_row))
+        import jax
+        import jax.numpy as jnp
+        # offline shapes exactly: categorical over (1, V) logits; the
+        # first token divides by raw temperature, scan steps clamp
+        denom = max(temperature, 1e-6) if clamp else temperature
+        return int(jax.random.categorical(
+            jnp.asarray(key), jnp.asarray(logits_row)[None, :] / denom,
+            axis=-1)[0])
+
+    # -- worker -------------------------------------------------------- #
+    def _run(self):
+        try:
+            while True:
+                with self._cv:
+                    while (not self._queue and not self._n_active
+                           and not self._closing and not self._abort):
+                        self._cv.wait()
+                    if self._abort:
+                        break
+                    if (self._closing and not self._queue
+                            and not self._n_active):
+                        return
+                    admits = []
+                    while self._free and self._queue:
+                        admits.append((self._free.pop(),
+                                       self._queue.popleft()))
+                for slot, req in admits:
+                    try:
+                        self._admit(slot, req)
+                    except BaseException as e:  # noqa: BLE001
+                        req.stream._finish(error=e)
+                        with self._cv:
+                            self._free.append(slot)
+                if self._n_active:
+                    self._step()
+        except BaseException as e:  # noqa: BLE001
+            self._fail_all(e)
+            return
+        self._fail_all(ServingClosed("engine closed before completion"))
+
+    def _admit(self, slot: int, req: _Request) -> None:
+        t = req.prompt0.shape[0]
+        bucket = self.bucket_for(t)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :t] = req.prompt0
+        x = {"ids": ids, "len": np.int32(t)}
+        with _tracer.span("lm/prefill", cat="serve", bucket=bucket,
+                          prompt_len=t):
+            logits, k, v = self.prefill_cache(
+                self._params, self._buffers, x)
+            logits = np.asarray(logits)  # sync; (1, V) f32
+        first0 = self._pick(logits[0], req.temperature, req.first_key,
+                            clamp=False)
+        req.stream._emit(first0 + 1)
+        self.metrics.record_first_token(
+            req.stream.first_token_at - req.stream.submitted_at)
+        if req.max_new == 1 or (req.eos0 is not None
+                                and first0 == req.eos0):
+            req.stream._finish()
+            self.metrics.record_complete()
+            with self._cv:
+                self._free.append(slot)
+            return
+        with _tracer.span("lm/insert", cat="serve", slot=slot,
+                          bucket=bucket):
+            self._k, self._v = self._insert_compiled(bucket)(
+                self._k, self._v, k, v, np.int32(slot))
+        st = _Slot(req, t, first0)
+        with self._cv:
+            self._slots[slot] = st
+            self._n_active += 1
+
+    def _step(self):
+        token = np.zeros((self.slots,), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        active = []
+        for i, st in enumerate(self._slots):
+            if st is not None:
+                active.append((i, st))
+                token[i] = st.last0
+                pos[i] = st.pos_next
+        if not active:
+            return
+        with _tracer.span("lm/decode_step", cat="serve",
+                          active=len(active)):
+            logits, self._k, self._v = self._decode_compiled()(
+                self._params, token, pos, self._k, self._v)
+            logits = np.asarray(logits)  # sync; (S, V) f32
+        now = time.perf_counter()
+        itls = []
+        freed = []
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            nxt0 = self._pick(
+                logits[i], st.temperature,
+                st.step_keys[st.step_idx]
+                if st.step_keys is not None else None,
+                clamp=True)
+            st.stream._emit(nxt0 + 1)
+            itls.append(now - st.last_emit_at)
+            st.last_emit_at = now
+            st.last0 = nxt0
+            st.pos_next += 1
+            st.step_idx += 1
+            st.remaining -= 1
+            if st.remaining <= 0 or (st.eos0 is not None
+                                     and nxt0 == st.eos0):
+                st.stream._finish()
+                self.metrics.record_complete()
+                freed.append(i)
+        self.metrics.record_step(len(active), itls)
+        if freed:
+            with self._cv:
+                for i in freed:
+                    self._slots[i] = None
+                    self._free.append(i)
+                    self._n_active -= 1
+                self._cv.notify_all()
+
+    def _fail_all(self, error: BaseException) -> None:
+        with self._cv:
+            pending = [r.stream for r in self._queue]
+            self._queue.clear()
+            for i, st in enumerate(self._slots):
+                if st is not None:
+                    pending.append(st.stream)
+                    self._slots[i] = None
+                    self._free.append(i)
+            self._n_active = 0
+        for s in pending:
+            s._finish(error=error)
+
+    # ------------------------------------------------------------------ #
+    def stats(self) -> dict:
+        with self._cv:
+            queued = len(self._queue)
+            active = self._n_active
+        return {
+            "name": self.name,
+            "slots": self.slots,
+            "active": active,
+            "queued": queued,
+            "cache_len": self.cache_len,
+            "prefill_buckets": list(self.prefill_buckets),
+            "prefill_cache": self.prefill_cache.stats(),
+            "metrics": self.metrics.snapshot(),
+        }
+
+    def cache_buffer_pointers(self) -> tuple:
+        """Device buffer addresses of the resident k/v caches (donation
+        regression hook: stable across decode steps)."""
+
+        def ptr(a):
+            try:
+                return a.unsafe_buffer_pointer()
+            except AttributeError:
+                bufs = getattr(a, "device_buffers", None)
+                return bufs[0].unsafe_buffer_pointer() if bufs else None
+
+        return ptr(self._k), ptr(self._v)
+
+    def close(self, timeout: Optional[float] = 30.0) -> None:
+        """Drain: stop admitting, finish queued + in-flight requests;
+        after ``timeout`` the remainder resolve with ServingClosed."""
+        with self._cv:
+            self._closing = True
+            self._cv.notify_all()
+        self._worker.join(timeout)
+        if self._worker.is_alive():
+            with self._cv:
+                self._abort = True
+                self._cv.notify_all()
+            self._worker.join(5.0)
+            self._fail_all(ServingClosed("engine closed before "
+                                         "completion"))
+
+    def __enter__(self) -> "LMServingEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

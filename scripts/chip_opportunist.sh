@@ -57,6 +57,7 @@ if [ "$SMOKE" = "1" ]; then
   STRESS_ARGS="--max-mb 4"
   CONV_ARGS="--lenet-epochs 1 --lenet-records 256 --vgg-epochs 1 --vgg-records 128 --batch 32"
   SCAN_ITERS=1; SCAN_STEPS=2
+  SERVE_LM_ARGS="--requests 6 --slots 2 --cache-len 64 --mean-gap-ms 5 --probes 1"
 else
   BENCH_FLOOR=100            # a degraded-window crawl is not a result
   BENCH_ITERS=20
@@ -67,6 +68,7 @@ else
   STRESS_ARGS="--max-mb 256"
   CONV_ARGS=""
   SCAN_ITERS=3; SCAN_STEPS=8
+  SERVE_LM_ARGS="--requests 48 --slots 8 --cache-len 128"
 fi
 
 # A stage artifact counts as done when it parses as JSON and carries
@@ -101,6 +103,7 @@ PYEOF
 # driver commits leftovers anyway.
 ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
+BENCH_LM_SERVE.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
 SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
@@ -202,6 +205,43 @@ run_stage() {  # run_stage <name> <artifact> <budget> <cmd...>
   return 1
 }
 
+# The LM-serving bench ships with a CPU-proven BENCH_LM_SERVE.json
+# committed to the repo, so the plain ok() gate (valid JSON, complete)
+# would mark the stage permanently done and it would never fire on the
+# chip.  ok_lm additionally requires the artifact's platform to be
+# non-CPU in real runs (the smoke rehearsal accepts its own CPU one).
+# The resumable bench keys row reuse on platform + config, so a TPU
+# window starts its own rows instead of extending the CPU set.
+ok_lm() {  # ok_lm <file>
+  ok "$1" || return 1
+  [ "$SMOKE" = "1" ] && return 0
+  python - "$1" <<'PYEOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+sys.exit(0 if d.get("platform") not in (None, "cpu") else 1)
+PYEOF
+}
+
+# serve-lm rides right after the headline bench: it is the only stage
+# exercising the decode hot path (prefill/insert/decode + donated HBM
+# caches), cheap (<=600s, model params ~1 MB so every transfer is far
+# below the 32 MB relay ceiling), and never gates the round's exit or
+# the scaling regen — a window that only has time for the headline
+# bench still regenerates.
+serve_lm_stage() {
+  ok_lm BENCH_LM_SERVE.json && return 0
+  say "stage serve_lm: firing (budget 600s): python -u bench.py --serve-lm $SERVE_LM_ARGS"
+  timeout 600 python -u bench.py --serve-lm $SERVE_LM_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm BENCH_LM_SERVE.json; then
+    say "stage serve_lm: DONE"
+    return 0
+  fi
+  say "stage serve_lm: not done (rc=$rc)"
+  record_incident serve_lm "$rc"
+  return 1
+}
+
 say "opportunist start"
 # Bonus stages (scan experiment, tunnel stress) are diagnostics: they
 # get a bounded number of firings and never gate the round's exit — a
@@ -265,6 +305,7 @@ while :; do
     # completed one is skipped instantly on later passes.
     BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=$BENCH_ITERS \
       run_stage bench BENCH_LAST.json 420 python -u bench.py
+    serve_lm_stage
     # dispatch-overhead experiment: same step, SCAN_STEPS per device
     # call (the scan variant never writes BENCH_LAST — different
     # metric); tee to stderr so the diagnosis lines land in the log,

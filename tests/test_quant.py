@@ -239,7 +239,7 @@ def test_compile_cache_f32_and_int8_coexist():
     y_f32 = cache(m.params, m.buffers, x)
     y_q = cache(q.params, q.buffers, x)
     assert len(cache) == 2                    # same shape, distinct entries
-    tags = sorted(k[3] for k in cache._entries)
+    tags = sorted(k[-1] for k in cache._entries)  # params dtype tag
     assert tags == ["f32", "int8"]
     # both executables live: re-running either is a hit, not a recompile
     misses = cache.misses

@@ -485,3 +485,52 @@ def test_all_replicas_dead_is_bounded_backend_lost(inject):
             fut.result(timeout=60)
     finally:
         rs.close()
+
+
+def test_quantized_replica_coexists_and_fails_over_exactly(inject):
+    """Heterogeneous replica set: a Module.quantize() int8 clone serves
+    next to its f32 original behind ONE batcher (the compile cache keys
+    them apart by params dtype).  The failover contract is per-replica
+    exactness — and once the f32 replica dies, every answer is exactly
+    what the int8 engine produces alone."""
+    from bigdl_tpu.resilience import ReplicaSet
+    from bigdl_tpu.serving import ServingEngine
+
+    # weights must clear QuantPolicy's min_size=128 floor or the clone
+    # silently stays f32 and the test is vacuous
+    model = nn.Sequential(nn.Linear(8, 32), nn.LogSoftMax()).build(seed=0)
+    qmodel = model.quantize()
+    xs = np.random.RandomState(7).randn(10, 8).astype(np.float32)
+
+    kw = dict(input_shape=(8,), max_batch_size=4, max_wait_ms=1.0)
+    with ServingEngine(model, **kw) as e32:
+        exp32 = [e32.predict(xs[i:i + 1], timeout=60)
+                 for i in range(len(xs))]
+    with ServingEngine(qmodel, **kw) as e8:
+        assert e8.quant_dtype == "int8"  # quantization really engaged
+        exp8 = [e8.predict(xs[i:i + 1], timeout=60)
+                for i in range(len(xs))]
+    assert any(not np.array_equal(a, b) for a, b in zip(exp32, exp8))
+
+    # the f32 replica dies from its 3rd dispatched batch onwards
+    inject("serving.dispatch:die:name=r0,after=2")
+    failovers0 = _counter("resilience/failovers")
+    rs = ReplicaSet([model, qmodel], failure_threshold=2,
+                    cooldown_s=300.0, **kw)
+    try:
+        assert rs._replicas[0].engine.quant_dtype == "f32"
+        assert rs._replicas[1].engine.quant_dtype == "int8"
+        got = [rs.predict(xs[i:i + 1], timeout=60)
+               for i in range(len(xs))]
+        # per-replica exactness: every answer matches the single-engine
+        # output of whichever replica served it, bit for bit
+        for g, a, b in zip(got, exp32, exp8):
+            assert (np.array_equal(g, a) or np.array_equal(g, b))
+        st = rs.stats()
+        assert st["replicas"]["r0"]["state"] == "open"
+        assert st["replicas"]["r1"]["state"] == "healthy"
+        assert _counter("resilience/failovers") - failovers0 >= 1
+        # with r0 open (cooldown 300s), the tail is all-int8 exact
+        assert np.array_equal(got[-1], exp8[-1])
+    finally:
+        rs.close()

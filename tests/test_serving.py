@@ -435,3 +435,100 @@ def test_bench_serve_cli_artifact_and_resume(tmp_path):
     reused = {r["stage"]: r.get("reused_from_previous_run")
               for r in d["rows"] if r.get("stage") != "warmup"}
     assert all(reused.values()), reused
+
+
+# --------------------------------------------------------------------------- #
+# pytree outputs (multi-headed models) through batcher + engine               #
+# --------------------------------------------------------------------------- #
+
+class _TwoHeaded:
+    """Duck-typed built module with a pytree output: the multi-headed
+    model case the batcher's leaf-wise slice-back exists for."""
+
+    def __init__(self):
+        self._inner = _tiny_model()
+        self.params = self._inner.params
+        self.buffers = self._inner.buffers
+
+    def _built(self):
+        return True
+
+    def apply(self, params, x, buffers=None, training=False, rng=None):
+        import jax.numpy as jnp
+        y, buffers = self._inner.apply(params, x, buffers=buffers,
+                                       training=training, rng=rng)
+        return {"cls": y, "reg": (y[:, :2] * 2.0, jnp.sum(y, axis=1))}, \
+            buffers
+
+
+def _two_headed_ref(model, x):
+    import jax
+    y, _ = model._inner.apply(model.params, x, buffers=model.buffers,
+                              training=False,
+                              rng=jax.random.PRNGKey(0))
+    y = np.asarray(y)
+    return {"cls": y, "reg": (y[:, :2] * 2.0, y.sum(axis=1))}
+
+
+def test_batcher_pytree_output_slice_back():
+    """Fake run_batch returning a dict of heads: every leaf is sliced
+    back per request, including the oversized chunked path."""
+
+    def run(x):
+        return {"a": x + 1, "b": (x[:, :1] * 2, x.sum(axis=1))}
+
+    b = DynamicBatcher(run, max_batch_size=8, max_wait_ms=1)
+    try:
+        for n in (1, 3, 20):  # 20 > max_batch_size: chunk + concat
+            x = np.arange(n * 4, dtype=np.float32).reshape(n, 4)
+            y = b.submit(x).result(timeout=10)
+            assert set(y) == {"a", "b"}
+            np.testing.assert_allclose(y["a"], x + 1)
+            np.testing.assert_allclose(y["b"][0], x[:, :1] * 2)
+            np.testing.assert_allclose(y["b"][1], x.sum(axis=1))
+    finally:
+        b.close()
+
+
+def test_engine_pytree_outputs_end_to_end():
+    """Two-headed module through the full ServingEngine: per-request
+    slice-back of every leaf, mixed sizes, oversized chunking, and
+    predict_one's leaf-wise batch-dim strip."""
+    model = _TwoHeaded()
+    with ServingEngine(model, input_shape=(8,), max_batch_size=8,
+                       max_wait_ms=1.0) as eng:
+        eng.warmup()
+        rng = np.random.RandomState(0)
+        for n in (1, 5, 20):  # 20 > max_batch_size
+            x = rng.randn(n, 8).astype(np.float32)
+            y = eng.predict(x, timeout=120)
+            ref = _two_headed_ref(model, x)
+            assert set(y) == {"cls", "reg"}
+            assert isinstance(y["cls"], np.ndarray)
+            np.testing.assert_allclose(y["cls"], ref["cls"], rtol=1e-5)
+            np.testing.assert_allclose(y["reg"][0], ref["reg"][0],
+                                       rtol=1e-5)
+            np.testing.assert_allclose(y["reg"][1], ref["reg"][1],
+                                       rtol=1e-5)
+        one = eng.predict_one(rng.randn(8).astype(np.float32),
+                              timeout=120)
+        assert one["cls"].shape == (4,) and one["reg"][1].shape == ()
+
+
+def test_engine_rejects_output_leaf_without_batch_dim():
+    """The slice-back contract is validated: a head whose leading dim
+    is not the batch dim fails loudly instead of shuffling rows."""
+
+    class _Bad(_TwoHeaded):
+        def apply(self, params, x, buffers=None, training=False,
+                  rng=None):
+            import jax.numpy as jnp
+            out, b = super().apply(params, x, buffers=buffers,
+                                   training=training, rng=rng)
+            return {"ok": out["cls"], "scalar": jnp.sum(out["cls"])}, b
+
+    model = _Bad()
+    with ServingEngine(model, input_shape=(8,), max_batch_size=4,
+                       max_wait_ms=1.0) as eng:
+        with pytest.raises(TypeError, match="leading batch dim"):
+            eng.predict(np.zeros((3, 8), np.float32), timeout=120)
