@@ -1121,6 +1121,308 @@ def _serve_lm_bench(argv) -> int:
                 pass
 
 
+# ---------------------------------------------------------------------------
+# --slo: trace-driven load sweep + SLO guardrails + chaos replay
+#        -> BENCH_SLO.json
+# ---------------------------------------------------------------------------
+
+
+def _slo_load_point(eng, model, gen, ctrl, slo_s: float) -> dict:
+    """One open-loop load point: replay the trace with the controller
+    live, then drain every ACCEPTED stream and split completions into
+    under/over SLO.  Goodput counts only requests the client actually
+    got back under target — sheds and SLO misses are offered load that
+    bought nothing."""
+    from bigdl_tpu.obs import get_registry
+
+    rej = get_registry().counter("serving/rejected_total", unit="requests")
+    rej0 = rej.get()[0]
+    with ctrl:
+        t_start = time.perf_counter()
+        report = gen.run(
+            lambda a: eng.submit(a.prompt, max_new_tokens=a.max_new))
+        ttfts, ends, lost = [], [], []
+        for a, stream in report.accepted:
+            try:
+                stream.result(timeout=600)
+                ttfts.append(stream.ttft_s)
+                ends.append(stream.finished_at)
+            except Exception as e:  # noqa: BLE001 — loss is data here
+                lost.append((a.index, repr(e)))
+    span = (max(ends) if ends else time.perf_counter()) - t_start
+    under = sum(1 for t in ttfts if t is not None and t <= slo_s)
+    return {
+        "offered": report.offered,
+        "accepted": len(report.accepted),
+        "shed": len(report.shed),
+        "submit_errors": len(report.errors),
+        "completed": len(ttfts),
+        "accepted_loss": len(lost),
+        "completed_under_slo": under,
+        "span_s": round(span, 3),
+        "goodput_rps": round(under / span, 3) if span > 0 else None,
+        "ttft": _percentiles_ms(ttfts),
+        "rejected_total_delta": rej.get()[0] - rej0,
+        "controller": ctrl.summary(),
+        "slot_limit": eng.slot_limit,
+        "max_queue": eng.max_queue,
+    }
+
+
+def _slo_chaos_stage(args, chaos_cfg: dict) -> dict:
+    """The chaos row: replay the recorded tunnel incidents mid-load
+    against a 2-replica set and account for every accepted request.
+
+    The contract under test is ZERO ACCEPTED-REQUEST LOSS: injected
+    transfer/dispatch/enqueue faults may shed new arrivals (typed,
+    counted), but anything the server accepted must complete with the
+    exact same answer the healthy set gives."""
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.resilience.replicaset import ReplicaSet
+    from bigdl_tpu.traffic import (ChaosReplayer, TraceLoadGenerator,
+                                   build_schedule)
+
+    model = nn.Sequential(nn.Linear(8, 4), nn.LogSoftMax()).build(seed=0)
+    gen = TraceLoadGenerator(
+        kind="poisson", rate_rps=args.chaos_rps,
+        duration_s=args.chaos_duration, seed=args.seed)
+    schedule = build_schedule(args.chaos_duration, seed=args.chaos_seed)
+
+    def payload(idx: int) -> np.ndarray:
+        return np.full((1, 8), (idx % 7) * 0.25, np.float32)
+
+    with ReplicaSet(model, n_replicas=args.replicas, input_shape=(8,),
+                    max_batch_size=16, max_queue=args.max_queue,
+                    failure_threshold=2, cooldown_s=0.5) as rs:
+        rs.warmup()
+        # healthy-set reference answers, one per distinct payload
+        refs = {i: rs.predict(payload(i), timeout=60) for i in range(7)}
+        replayer = ChaosReplayer(schedule, seed=args.chaos_seed)
+        with replayer:
+            report = gen.run(lambda a: rs.submit(payload(a.index)))
+            ok, lost = 0, []
+            for a, fut in report.accepted:
+                try:
+                    y = fut.result(timeout=120)
+                    if np.allclose(y, refs[a.index % 7], atol=1e-5):
+                        ok += 1
+                    else:
+                        lost.append((a.index, "result mismatch"))
+                except Exception as e:  # noqa: BLE001 — loss is data here
+                    lost.append((a.index, repr(e)))
+        injected = sum(v["fired"] for v in replayer.injector.stats().values())
+    return {
+        "config": chaos_cfg,
+        "offered": report.offered,
+        "accepted": len(report.accepted),
+        "shed": len(report.shed),
+        "submit_errors": len(report.errors),
+        "completed_exact": ok,
+        "accepted_loss": len(lost),
+        "lost": lost[:8],
+        "zero_accepted_loss": not lost,
+        "faults_injected": injected,
+        "chaos": replayer.summary(),
+    }
+
+
+def _slo_bench(argv) -> int:
+    """Goodput-under-SLO vs offered load -> BENCH_SLO.json.
+
+    Open-loop sweep over --loads with the SLOController live (slot
+    scale-up, then admission control), then one chaos row replaying
+    TUNNEL_INCIDENTS.json mid-load.  Same resumable-artifact contract
+    as the other benches: rewrite after every row, ``complete: false``
+    until the final flush, reuse only platform+config-matched rows."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --slo")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--loads", default="4,8,16,32,64",
+                    help="comma-separated offered loads (requests/s)")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="trace length per load point (s)")
+    ap.add_argument("--kind", default="bursty",
+                    choices=("poisson", "bursty", "diurnal"))
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--cache-len", type=int, default=128)
+    ap.add_argument("--ttft-slo-ms", type=float, default=500.0)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--tick-ms", type=float, default=50.0)
+    ap.add_argument("--chaos-duration", type=float, default=8.0,
+                    help="chaos row length (s); 0 skips the chaos row")
+    ap.add_argument("--chaos-rps", type=float, default=30.0)
+    ap.add_argument("--chaos-seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=2)
+    args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_SLO.json")
+    loads = [float(v) for v in args.loads.split(",") if v.strip()]
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import jax
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.obs import get_registry
+    from bigdl_tpu.serving import LMServingEngine
+    from bigdl_tpu.traffic import SLOController, TraceLoadGenerator, detect_knee
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    slo_s = args.ttft_slo_ms / 1000.0
+    # clamp the length menus so prompt + budget always fits the cache
+    # (a small --cache-len smoke run must shed, not error)
+    pls = tuple(p for p in _LM_PROMPT_LENS
+                if p + min(_LM_MAX_NEWS) <= args.cache_len) or (8,)
+    mns = tuple(m for m in _LM_MAX_NEWS
+                if max(pls) + m <= args.cache_len) or (8,)
+    chaos_cfg = {"duration_s": args.chaos_duration, "rps": args.chaos_rps,
+                 "seed": args.chaos_seed, "replicas": args.replicas}
+    config = {"model": "transformer_lm", "vocab": 256, "hidden": 128,
+              "heads": 4, "layers": 4, "pos": "rope",
+              "slots": args.slots, "cache_len": args.cache_len,
+              "kind": args.kind, "loads": loads,
+              "duration_s": args.duration, "seed": args.seed,
+              "ttft_slo_ms": args.ttft_slo_ms,
+              "max_queue": args.max_queue, "tick_ms": args.tick_ms,
+              # controller policy is part of the row-reuse identity: a
+              # row measured under a different ladder is a different
+              # experiment
+              "controller": {"window": 6, "hot_streak": 1,
+                             "cool_s": 2.0, "start": "tightest",
+                             "hold_shedding": True, "ladder_floor": 2,
+                             "shed_free": "whole_point"},
+              "prompt_lens": list(pls),
+              "max_news": list(mns),
+              "chaos": chaos_cfg}
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "slo_traffic_harness", "platform": platform,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+    model = TransformerLM(
+        vocab_size=config["vocab"], hidden_size=config["hidden"],
+        n_head=config["heads"], n_layers=config["layers"],
+        max_len=args.cache_len, pos_encoding="rope").build(seed=7)
+    # admission ladder: loosest bound first, tightened level by level
+    # once slot scale-up is exhausted
+    levels = sorted({max(1, args.max_queue >> k) for k in range(6)},
+                    reverse=True)
+    start_limit = max(1, args.slots // 2)
+
+    eng = LMServingEngine(model, slots=args.slots, cache_len=args.cache_len,
+                          max_queue=args.max_queue)
+    try:
+        t0 = time.perf_counter()
+        compiled = eng.warmup()
+        rows.append({"stage": "warmup", "prefill_compiled": compiled,
+                     "warmup_s": round(time.perf_counter() - t0, 3)})
+        flush()
+
+        for load in loads:
+            stage = f"load_{load:g}"
+            if stage in prev:
+                row = dict(prev[stage])
+                row["reused_from_previous_run"] = True
+            else:
+                # fresh actuator state per point: half the slots and the
+                # TIGHTEST admission bound (fail-closed) — an open start
+                # lets the first burst queue deeper than the whole TTFT
+                # budget before the window sees it; cool ticks relax the
+                # bound as fast as the p99 actually allows
+                eng.set_slot_limit(start_limit)
+
+                def scale_up():
+                    cur = eng.slot_limit
+                    return eng.set_slot_limit(cur + 1) > cur
+
+                rej_ctr = get_registry().counter("serving/rejected_total",
+                                                 unit="requests")
+                # the shed window spans the whole load point: once a
+                # point sheds, its offered load has proven itself past
+                # capacity, and every relax probe after that accepts
+                # doomed-latency requests that the point's p99 keeps
+                # forever (a quiet burst gap is not recovery)
+                shed_free = max(6, int(round((args.duration + 2.0)
+                                             * 1000.0 / args.tick_ms)))
+                cool = max(6, int(round(2.0 * 1000.0 / args.tick_ms)))
+                ctrl = SLOController(
+                    histogram=eng.metrics.ttft, target_p99_s=slo_s,
+                    interval_s=args.tick_ms / 1000.0, window_intervals=6,
+                    scale_up=scale_up, set_admission=eng.set_max_queue,
+                    admission_levels=levels, hot_streak=1,
+                    cool_streak=cool, start_level=len(levels) - 1,
+                    rejections=lambda: rej_ctr.get()[0],
+                    shed_free_intervals=shed_free)
+                gen = TraceLoadGenerator(
+                    kind=args.kind, rate_rps=load, duration_s=args.duration,
+                    seed=args.seed, vocab=config["vocab"],
+                    prompt_lens=pls, max_news=mns)
+                row = {"stage": stage, "load_rps": load,
+                       **_slo_load_point(eng, model, gen, ctrl, slo_s)}
+            rows.append(row)
+            flush()
+
+        if args.chaos_duration > 0:
+            if "chaos" in prev:
+                row = dict(prev["chaos"])
+                row["reused_from_previous_run"] = True
+            else:
+                row = {"stage": "chaos",
+                       **_slo_chaos_stage(args, chaos_cfg)}
+            rows.append(row)
+            flush()
+
+        curve = [r for r in rows if r.get("stage", "").startswith("load_")]
+        knee = detect_knee(curve, offered_key="load_rps",
+                           goodput_key="goodput_rps")
+        past_knee = [r for r in curve
+                     if knee["knee_rps"] is not None
+                     and r["load_rps"] > knee["knee_rps"]
+                     and r["ttft"]["p99_ms"] is not None]
+        chaos_row = next((r for r in rows if r.get("stage") == "chaos"),
+                         None)
+        result["summary"] = {
+            **knee,
+            "slo_ttft_p99_ms": args.ttft_slo_ms,
+            "p99_under_slo_past_knee": (
+                all(r["ttft"]["p99_ms"] <= args.ttft_slo_ms
+                    for r in past_knee) if past_knee else None),
+            "total_shed": sum(r["shed"] for r in curve),
+            "total_accepted_loss": sum(r["accepted_loss"] for r in curve),
+            "chaos_zero_accepted_loss": (
+                chaos_row.get("zero_accepted_loss")
+                if chaos_row else None),
+            "chaos_faults_injected": (
+                chaos_row.get("faults_injected") if chaos_row else None),
+        }
+        result["complete"] = True
+        flush()
+        print(json.dumps({
+            "metric": "slo_peak_goodput_rps",
+            "value": result["summary"]["peak_goodput_rps"],
+            "unit": "requests/sec", "platform": platform,
+            **{k: v for k, v in result["summary"].items()
+               if k != "peak_goodput_rps"}}), flush=True)
+        return 0
+    finally:
+        eng.close()
+
+
 if __name__ == "__main__":
     if ("--trace" in sys.argv and "--serve" not in sys.argv
             and "--serve-lm" not in sys.argv):
@@ -1129,6 +1431,8 @@ if __name__ == "__main__":
         # down as BIGDL_TPU_TRACE and strip it here
         sys.argv = [a for a in sys.argv if a != "--trace"]
         os.environ["BIGDL_TPU_TRACE"] = "1"
+    if "--slo" in sys.argv:
+        sys.exit(_slo_bench([a for a in sys.argv[1:] if a != "--slo"]))
     if "--serve-lm" in sys.argv:
         sys.exit(_serve_lm_bench(
             [a for a in sys.argv[1:] if a != "--serve-lm"]))

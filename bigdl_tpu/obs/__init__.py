@@ -30,7 +30,8 @@ Quickstart::
     print(reg.snapshot())
 """
 from bigdl_tpu.obs.registry import (Counter, FnGauge, Gauge, Histogram,
-                                    MetricRegistry, get_registry)
+                                    MetricRegistry, get_registry,
+                                    percentile_from_counts)
 from bigdl_tpu.obs.tracer import Tracer, get_tracer
 from bigdl_tpu.obs.watchdog import (StallWatchdog, env_watchdog_enabled,
                                     env_watchdog_kwargs, shared_watchdog,
@@ -39,7 +40,7 @@ from bigdl_tpu.obs.watchdog import (StallWatchdog, env_watchdog_enabled,
 __all__ = [
     "Tracer", "get_tracer",
     "Counter", "Gauge", "FnGauge", "Histogram", "MetricRegistry",
-    "get_registry",
+    "get_registry", "percentile_from_counts",
     "StallWatchdog", "env_watchdog_enabled", "env_watchdog_kwargs",
     "shared_watchdog", "thread_stacks",
 ]

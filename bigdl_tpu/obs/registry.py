@@ -41,6 +41,25 @@ def _log_edges() -> List[float]:
 _EDGES = _log_edges()
 
 
+def percentile_from_counts(counts, p: float) -> Optional[float]:
+    """Percentile over a raw bucket-count vector shaped like
+    ``Histogram.counts()`` (upper bucket edge, same conservative
+    estimate as ``Histogram.percentile``).  The windowed-p99 primitive:
+    subtracting two ``counts()`` snapshots gives the histogram of just
+    the interval between them — how the SLO controller reads a sliding
+    p99 out of the lifetime histograms the engines publish."""
+    total = sum(counts)
+    if not total:
+        return None
+    rank = max(1, int(round(total * p / 100.0)))
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= rank:
+            return _EDGES[i] if i < len(_EDGES) else _EDGES[-1]
+    return _EDGES[-1]
+
+
 class Counter:
     """Monotonic-ish accumulator with the reference Metrics' (value,
     parallel-count) pair (optim/Metrics.scala's AtomicDouble + parallel
@@ -132,6 +151,11 @@ class Histogram:
         self.sum += seconds
         if seconds > self.max:
             self.max = seconds
+
+    def counts(self) -> List[int]:
+        """Copy of the raw bucket counts (pair with a later copy and
+        ``percentile_from_counts`` for windowed percentiles)."""
+        return list(self._counts)
 
     def percentile(self, p: float) -> Optional[float]:
         """p in [0, 100]; None when empty."""

@@ -28,6 +28,7 @@ stay importable from low-level modules (``utils.transfer``,
 from __future__ import annotations
 
 from bigdl_tpu.resilience.errors import (BackendLostError,
+                                         ServingOverloaded,
                                          TransientBackendError,
                                          classify_error)
 from bigdl_tpu.resilience.faults import (FaultInjector, fault_point,
@@ -35,7 +36,8 @@ from bigdl_tpu.resilience.faults import (FaultInjector, fault_point,
 from bigdl_tpu.resilience.retry import with_backoff
 
 __all__ = [
-    "BackendLostError", "TransientBackendError", "classify_error",
+    "BackendLostError", "TransientBackendError", "ServingOverloaded",
+    "classify_error",
     "FaultInjector", "fault_point", "refresh_from_env",
     "with_backoff", "ReplicaSet",
 ]

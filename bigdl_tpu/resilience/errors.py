@@ -28,6 +28,15 @@ class BackendLostError(RuntimeError):
     spin against it (round 4 died waiting on exactly this)."""
 
 
+class ServingOverloaded(TransientBackendError):
+    """Typed overload rejection: backpressure or admission control shed
+    this request at enqueue.  Transient in the taxonomy — the server is
+    healthy but saturated, so the SAME request can succeed once load
+    drains (retry with backoff, or route elsewhere).  Every raise of
+    this type increments the ``serving/rejected_total`` obs counter,
+    the accounting the SLO controller and goodput metric depend on."""
+
+
 #: Substrings that mark a retryable wobble (same set the bench.py
 #: supervisor restarts a sweep on).  RESOURCE_EXHAUSTED is here on
 #: purpose: for transfers the remedy is the chunk-size downshift that

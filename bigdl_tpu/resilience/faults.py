@@ -185,9 +185,16 @@ class FaultInjector:
         log.info("fault injected: %s at %s", spec.describe(), site)
 
     def stats(self) -> dict:
+        # aggregate per describe(): a chaos schedule arms many
+        # identical specs (one per event) — last-wins keying would
+        # silently drop the fired counts of all but one
         with self._lock:
-            return {s.describe(): {"seen": s.seen, "fired": s.fired}
-                    for s in self.specs}
+            out: dict = {}
+            for s in self.specs:
+                d = out.setdefault(s.describe(), {"seen": 0, "fired": 0})
+                d["seen"] += s.seen
+                d["fired"] += s.fired
+            return out
 
 
 _active: Optional[FaultInjector] = None

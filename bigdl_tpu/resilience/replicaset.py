@@ -120,15 +120,21 @@ class ReplicaSet:
         self._clock = clock
         self._lock = threading.Lock()
         self._registry = get_registry()
+        # kept for scale_to(): new replicas are built from the same
+        # module (heterogeneous sets grow with their FIRST module) and
+        # the same engine policy the constructor used
+        self._scale_module = modules[0] if modules is not None else module
+        self._engine_cls = ServingEngine
+        self._engine_cfg = dict(input_shape=input_shape, buckets=buckets,
+                                max_batch_size=max_batch_size, dtype=dtype,
+                                platform=platform, **engine_kwargs)
+        self._next_idx = n_replicas
         self._replicas = []
         for i in range(n_replicas):
             name = f"r{i}"
             engine = ServingEngine(
                 modules[i] if modules is not None else module,
-                name=name, with_batcher=False,
-                input_shape=input_shape, buckets=buckets,
-                max_batch_size=max_batch_size, dtype=dtype,
-                platform=platform, **engine_kwargs)
+                name=name, with_batcher=False, **self._engine_cfg)
             self._replicas.append(_Replica(name, engine))
         ref = self._replicas[0].engine
         # one batching policy for the whole set, published as the
@@ -145,6 +151,11 @@ class ReplicaSet:
             pool=Engine.default_or_create() if use_shared_pool else None)
         self._closed = False
         self._publish_open_circuits()
+        self._publish_replica_count()
+
+    def _publish_replica_count(self) -> None:
+        n = sum(1 for r in self._replicas if r.state != DRAINING)
+        self._registry.gauge("resilience/replicas").set(n)
 
     # ---------------------------------------------------------------- #
     # health / breaker state machine (all transitions under _lock)     #
@@ -261,7 +272,60 @@ class ReplicaSet:
     def warmup(self, input_shape: Optional[tuple] = None) -> int:
         """Pre-compile every bucket on every replica; returns the total
         number of executables compiled."""
-        return sum(r.engine.warmup(input_shape) for r in self._replicas)
+        return sum(r.engine.warmup(input_shape) for r in self._replicas
+                   if r.state != DRAINING)
+
+    def scale_to(self, n: int, *, drain_timeout_s: float = 10.0) -> int:
+        """SLO-controller actuator: grow or shrink the live replica
+        count without touching the queue.
+
+        Growing builds fresh batcher-less engines (the same module —
+        heterogeneous sets grow with their first member's) and warms
+        them when an input shape is known, so the next dispatch pays no
+        compile.  Shrinking marks the newest replicas DRAINING (the
+        picker skips them immediately), waits for their in-flight
+        batches, then closes their engines — an accepted request is
+        never dropped by a scale-down.  Returns the live count."""
+        n = int(n)
+        if n < 1:
+            raise ValueError("scale_to needs n >= 1")
+        with self._lock:
+            live = [r for r in self._replicas if r.state != DRAINING]
+        if n > len(live):
+            warm_shape = live[0].engine.input_shape if live else None
+            for _ in range(n - len(live)):
+                name = f"r{self._next_idx}"
+                self._next_idx += 1
+                engine = self._engine_cls(
+                    self._scale_module, name=name, with_batcher=False,
+                    **self._engine_cfg)
+                if warm_shape is not None:
+                    engine.warmup(warm_shape)
+                with self._lock:
+                    self._replicas.append(_Replica(name, engine))
+                log.info("replica %s: added by scale_to(%d)", name, n)
+            self._registry.counter("resilience/scale_ups").add(n - len(live))
+        elif n < len(live):
+            victims = live[n:]  # newest first out: r0 keeps seniority
+            with self._lock:
+                for r in victims:
+                    r.state = DRAINING
+            deadline = time.monotonic() + float(drain_timeout_s)
+            for r in victims:
+                while r.inflight > 0 and time.monotonic() < deadline:
+                    time.sleep(0.005)
+                r.engine.close()
+                log.info("replica %s: drained and closed by scale_to(%d)",
+                         r.name, n)
+            with self._lock:
+                self._replicas = [r for r in self._replicas
+                                  if r not in victims]
+            self._registry.counter("resilience/scale_downs") \
+                .add(len(victims))
+        self._publish_open_circuits()
+        self._publish_replica_count()
+        with self._lock:
+            return sum(1 for r in self._replicas if r.state != DRAINING)
 
     def submit(self, x, *, batched: bool = True) -> Future:
         if self._closed:

@@ -21,7 +21,7 @@ Quickstart::
         print(eng.stats()["compile_cache"]["hit_rate"])
 """
 from bigdl_tpu.serving.batcher import (DynamicBatcher, ServingClosed,
-                                       ServingQueueFull,
+                                       ServingOverloaded, ServingQueueFull,
                                        power_of_two_buckets)
 from bigdl_tpu.serving.compile_cache import CompileCache
 from bigdl_tpu.serving.engine import ServingEngine
@@ -33,6 +33,6 @@ from bigdl_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 __all__ = [
     "ServingEngine", "DynamicBatcher", "CompileCache", "HostStager",
     "ServingMetrics", "LatencyHistogram", "ServingQueueFull",
-    "ServingClosed", "power_of_two_buckets",
+    "ServingOverloaded", "ServingClosed", "power_of_two_buckets",
     "LMServingEngine", "LMStream", "LMMetrics", "prefill_bucket_lengths",
 ]

@@ -26,16 +26,29 @@ from typing import Callable, Optional, Sequence
 import numpy as np
 
 from bigdl_tpu.obs.tracer import get_tracer
+from bigdl_tpu.resilience.errors import ServingOverloaded, TransientBackendError
 
 _tracer = get_tracer()
 
 
-class ServingQueueFull(RuntimeError):
-    """Backpressure rejection: the bounded request queue is full."""
+class ServingQueueFull(ServingOverloaded):
+    """Backpressure rejection: the bounded request queue is full.
+    A :class:`~bigdl_tpu.resilience.errors.ServingOverloaded`, so the
+    taxonomy classifies it transient — retry once load drains."""
 
 
 class ServingClosed(RuntimeError):
     """The batcher/engine was closed; the request was not served."""
+
+
+def count_rejection() -> None:
+    """Process-wide typed-shed accounting: every ServingOverloaded
+    raised at an admission seam (batcher, LM engine, SLO admission
+    control) lands here, on top of the per-engine ``serving/rejected``
+    / ``serving/lm/rejected`` gauges — one counter the SLO controller
+    and the goodput metric can read without knowing which engine shed."""
+    from bigdl_tpu.obs import get_registry
+    get_registry().counter("serving/rejected_total", unit="requests").add(1)
 
 
 def power_of_two_buckets(max_batch_size: int) -> tuple:
@@ -154,10 +167,24 @@ class DynamicBatcher:
 
     def submit(self, x, n: Optional[int] = None) -> Future:
         """Enqueue a request of ``n`` examples (leading dim of ``x``);
-        raises ServingQueueFull when the bounded queue is full."""
+        raises ServingQueueFull (a ServingOverloaded) when the bounded
+        queue is full."""
         x = np.asarray(x)
         if n is None:
             n = int(x.shape[0]) if x.ndim else 1
+        # resilience hook: chaos exercises the admission path here.  An
+        # injected transient is surfaced as the SAME typed shed a real
+        # overload produces, so clients and the loadgen account for it
+        # identically; backend_lost passes through unconverted.
+        from bigdl_tpu.resilience.faults import fault_point
+        try:
+            fault_point("serving.enqueue", n=n)
+        except ServingOverloaded:
+            raise
+        except TransientBackendError as e:
+            count_rejection()
+            raise ServingOverloaded(
+                f"admission shed (injected at serving.enqueue): {e}") from e
         fut: Future = Future()
         with self._cv:
             if self._stop:
@@ -165,6 +192,7 @@ class DynamicBatcher:
             if len(self._queue) >= self._max_queue:
                 if self._metrics is not None:
                     self._metrics.record_reject()
+                count_rejection()
                 raise ServingQueueFull(
                     f"request queue full ({self._max_queue} pending); "
                     "retry later or raise max_queue")
@@ -180,6 +208,19 @@ class DynamicBatcher:
     def pending(self) -> int:
         with self._cv:
             return len(self._queue)
+
+    def set_max_queue(self, n: int) -> None:
+        """Admission-control actuator: rebind the queue bound live.  The
+        SLO controller shrinks it when saturated (shed instead of queue
+        collapse) and restores it once p99 recovers; already-queued
+        requests are never dropped, only new arrivals see the bound."""
+        with self._cv:
+            self._max_queue = max(0, int(n))
+
+    @property
+    def max_queue(self) -> int:
+        with self._cv:
+            return self._max_queue
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         """Stop accepting requests, drain what is queued, join the

@@ -47,7 +47,10 @@ import numpy as np
 
 from bigdl_tpu.obs import get_registry, get_tracer
 from bigdl_tpu.obs.registry import FnGauge, Histogram
-from bigdl_tpu.serving.batcher import ServingClosed, ServingQueueFull
+from bigdl_tpu.resilience.errors import (ServingOverloaded,
+                                         TransientBackendError)
+from bigdl_tpu.serving.batcher import (ServingClosed, ServingQueueFull,
+                                       count_rejection)
 from bigdl_tpu.serving.compile_cache import CompileCache
 from bigdl_tpu.utils.engine import select_platform
 
@@ -398,6 +401,11 @@ class LMServingEngine:
         # -- scheduler state (worker thread owns the slots) ------------- #
         self._cv = threading.Condition()
         self._queue: deque = deque()
+        # the SLO controller's decode-concurrency actuator: the decode
+        # executable always steps the full S physical slots (fixed
+        # shape — no recompile), but admission only fills slots up to
+        # this cap, trading throughput for per-token latency live
+        self._slot_limit = self.slots
         self._free = list(range(self.slots))
         self._slots: List[Optional[_Slot]] = [None] * self.slots
         self._n_active = 0
@@ -496,6 +504,19 @@ class LMServingEngine:
             if max_new > 1:
                 step_keys = np.asarray(jax.random.split(rng, max_new - 1))
 
+        # chaos hook on the admission path (same contract as the
+        # batcher's): an injected transient surfaces as the typed shed
+        from bigdl_tpu.resilience.faults import fault_point
+        try:
+            fault_point("serving.enqueue", name=self.name, n=t)
+        except ServingOverloaded:
+            raise
+        except TransientBackendError as e:
+            self.metrics.record_reject()
+            count_rejection()
+            raise ServingOverloaded(
+                f"admission shed (injected at serving.enqueue): {e}") from e
+
         stream = LMStream(prompt, max_new)
         req = _Request(stream, prompt - 1, max_new, temp, eos0,
                        first_key, step_keys)
@@ -504,12 +525,43 @@ class LMServingEngine:
                 raise ServingClosed("LMServingEngine is closed")
             if len(self._queue) >= self._max_queue:
                 self.metrics.record_reject()
+                count_rejection()
                 raise ServingQueueFull(
                     f"admission queue full ({self._max_queue})")
             self._queue.append(req)
             self._cv.notify_all()
         self.metrics.record_submit()
         return stream
+
+    # -- live control knobs (the SLO controller's actuators) ----------- #
+    def set_slot_limit(self, n: int) -> int:
+        """Cap decode concurrency at ``n`` of the S physical slots
+        (clamped to [1, slots]).  Cheap: the fixed-shape decode
+        executable is untouched; only admission stops filling slots
+        beyond the cap.  In-flight requests above a lowered cap finish
+        normally — the cap applies to new admissions.  Returns the
+        applied value."""
+        with self._cv:
+            self._slot_limit = max(1, min(int(n), self.slots))
+            self._cv.notify_all()
+            return self._slot_limit
+
+    @property
+    def slot_limit(self) -> int:
+        with self._cv:
+            return self._slot_limit
+
+    def set_max_queue(self, n: int) -> None:
+        """Admission-control actuator: rebind the queue bound live
+        (shed new arrivals with ServingQueueFull beyond it); queued
+        requests are never dropped."""
+        with self._cv:
+            self._max_queue = max(0, int(n))
+
+    @property
+    def max_queue(self) -> int:
+        with self._cv:
+            return self._max_queue
 
     def generate(self, prompt_ids, *,
                  timeout: Optional[float] = None, **kw) -> np.ndarray:
@@ -546,7 +598,9 @@ class LMServingEngine:
                             and not self._n_active):
                         return
                     admits = []
-                    while self._free and self._queue:
+                    while (self._free and self._queue
+                           and (self._n_active + len(admits))
+                           < self._slot_limit):
                         admits.append((self._free.pop(),
                                        self._queue.popleft()))
                 for slot, req in admits:
@@ -661,9 +715,13 @@ class LMServingEngine:
         with self._cv:
             queued = len(self._queue)
             active = self._n_active
+            slot_limit = self._slot_limit
+            max_queue = self._max_queue
         return {
             "name": self.name,
             "slots": self.slots,
+            "slot_limit": slot_limit,
+            "max_queue": max_queue,
             "active": active,
             "queued": queued,
             "cache_len": self.cache_len,

@@ -58,6 +58,7 @@ if [ "$SMOKE" = "1" ]; then
   CONV_ARGS="--lenet-epochs 1 --lenet-records 256 --vgg-epochs 1 --vgg-records 128 --batch 32"
   SCAN_ITERS=1; SCAN_STEPS=2
   SERVE_LM_ARGS="--requests 6 --slots 2 --cache-len 64 --mean-gap-ms 5 --probes 1"
+  SLO_ARGS="--loads 4,8 --duration 1.5 --chaos-duration 2 --chaos-rps 15 --slots 2 --cache-len 64"
 else
   BENCH_FLOOR=100            # a degraded-window crawl is not a result
   BENCH_ITERS=20
@@ -69,6 +70,7 @@ else
   CONV_ARGS=""
   SCAN_ITERS=3; SCAN_STEPS=8
   SERVE_LM_ARGS="--requests 48 --slots 8 --cache-len 128"
+  SLO_ARGS="--loads 4,8,16,32,64 --duration 5 --chaos-duration 8"
 fi
 
 # A stage artifact counts as done when it parses as JSON and carries
@@ -103,42 +105,21 @@ PYEOF
 # driver commits leftovers anyway.
 ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
-BENCH_LM_SERVE.json \
+BENCH_LM_SERVE.json BENCH_SLO.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
 SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
 
 # Relay-failure trace: every dead probe and every mid-stage backend
-# death appends a row here.  This is the empirical fault model the
-# resilience layer's injector specs (BIGDL_TPU_FAULTS) replay in tier-1
-# tests — real incidents in, deterministic chaos out.  Append is atomic
-# (rewrite via temp+rename) and tolerant of a corrupt/truncated file
-# (starts a fresh log rather than dying — the incident recorder must
-# never be the thing that kills the round).
+# death appends a row here.  This is the empirical fault model both the
+# tier-1 injector specs (BIGDL_TPU_FAULTS) and the chaos scheduler
+# (bench.py --slo) replay — real incidents in, deterministic chaos out.
+# One schema, one implementation: bigdl_tpu.traffic.incidents owns the
+# format (atomic append, corrupt-file tolerant) for this recorder AND
+# the schedule builder, so the two can never drift apart.
 record_incident() {  # record_incident <stage> <rc>
-  INC_STAGE="$1" INC_RC="$2" python - <<'PYEOF' 2>> "$LOG" || true
-import json, os, time
-path = "TUNNEL_INCIDENTS.json"
-doc = {"tool": "chip_opportunist", "incidents": []}
-try:
-    with open(path) as f:
-        prev = json.load(f)
-    if isinstance(prev, dict) and isinstance(prev.get("incidents"), list):
-        doc = prev
-except Exception:
-    pass  # missing or truncated: fresh log
-doc["incidents"].append({
-    "ts_unix": round(time.time(), 1),
-    "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
-    "stage": os.environ["INC_STAGE"],
-    "rc": int(os.environ["INC_RC"]),
-})
-tmp = path + ".tmp"
-with open(tmp, "w") as f:
-    json.dump(doc, f, indent=2)
-    f.write("\n")
-os.replace(tmp, path)
-PYEOF
+  python -m bigdl_tpu.traffic.incidents append "$1" "$2" \
+    >> "$LOG" 2>&1 || true
 }
 
 commit_artifacts() {  # commit_artifacts <message>
@@ -242,6 +223,26 @@ serve_lm_stage() {
   return 1
 }
 
+# slo rides right after serve-lm: the traffic harness sweeps offered
+# load over the same decode hot path and replays the round's OWN
+# incident log (TUNNEL_INCIDENTS.json) as mid-load chaos.  Same
+# ok_lm gate as serve-lm — the repo ships a CPU-proven BENCH_SLO.json,
+# which must never mark the TPU stage done — and the same
+# never-gates-the-round contract: exit and regen don't wait on it.
+slo_stage() {
+  ok_lm BENCH_SLO.json && return 0
+  say "stage slo: firing (budget 900s): python -u bench.py --slo $SLO_ARGS"
+  timeout 900 python -u bench.py --slo $SLO_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm BENCH_SLO.json; then
+    say "stage slo: DONE"
+    return 0
+  fi
+  say "stage slo: not done (rc=$rc)"
+  record_incident slo "$rc"
+  return 1
+}
+
 say "opportunist start"
 # Bonus stages (scan experiment, tunnel stress) are diagnostics: they
 # get a bounded number of firings and never gate the round's exit — a
@@ -306,6 +307,7 @@ while :; do
     BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=$BENCH_ITERS \
       run_stage bench BENCH_LAST.json 420 python -u bench.py
     serve_lm_stage
+    slo_stage
     # dispatch-overhead experiment: same step, SCAN_STEPS per device
     # call (the scan variant never writes BENCH_LAST — different
     # metric); tee to stderr so the diagnosis lines land in the log,

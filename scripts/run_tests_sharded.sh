@@ -13,7 +13,8 @@ N=${N:-3}
 # small and tier-1, and a broken retry/failover/resume path should fail
 # the run in seconds, before the full shards spend their minutes.
 if [ "${FAULTS_GATE:-1}" = "1" ]; then
-  python -m pytest tests/test_resilience.py -q -m faults || exit 1
+  python -m pytest tests/test_resilience.py tests/test_traffic.py \
+    -q -m faults || exit 1
 fi
 
 files=(tests/test_*.py)
