@@ -153,6 +153,145 @@ def _decode_step_slots(model, params, token, pos, k_cache, v_cache):
     return logits.astype(jnp.float32), k_cache, v_cache
 
 
+def _prefill_suffix_parts(model, params, ids0, last_index, prefix_len,
+                          blocks, k_arena, v_arena):
+    """Prefill a prompt SUFFIX against a cached prefix held in paged KV
+    blocks: ``ids0`` (1, Ts) is the (bucket-padded) suffix, whose tokens
+    live at absolute positions ``prefix_len + i``; ``blocks`` (Pb,) is
+    the padded block chain holding the prefix k/v in the arenas
+    (L, N, H, B, D) — padded entries point at the scratch block and are
+    masked via ``prefix_len``.  Returns (logits at suffix index
+    ``last_index``, k, v) with k/v (L, 1, H, Ts, D), exactly like
+    :func:`_prefill_parts` for the suffix rows.
+
+    Numerics are the offline prefill's: suffix queries attend the SAME
+    valid key set (cached prefix keys — stored post-RoPE, so directly
+    reusable — plus causal suffix keys) through the same
+    ``dot_product_attention`` core, with padded/garbage keys masked to
+    the same NEG_INF before the max-subtracted softmax."""
+    from bigdl_tpu.nn.attention import dot_product_attention
+
+    b, ts = ids0.shape
+    B = k_arena.shape[3]
+    pb = blocks.shape[0]
+    h = params["embed"][ids0]
+    positions = prefix_len + jnp.arange(ts)
+    if model.pos_encoding == "learned":
+        # dynamic gather (clamped for padded tail rows, which stay
+        # causally invisible exactly as in the plain bucketed prefill)
+        h = h + params["pos"][positions]
+    # key validity over the concatenated [prefix | suffix] axis: prefix
+    # entries are valid below prefix_len (padded chain entries and the
+    # block-padding gap are garbage), suffix entries are causal
+    jq = jnp.arange(ts)[:, None]
+    jk = jnp.arange(pb * B + ts)[None, :]
+    mask = ((jk < prefix_len)
+            | ((jk >= pb * B) & (jk - pb * B <= jq)))[None, None]
+
+    def body(h, layer):
+        bp, kc, vc = layer          # kc/vc: (N, H, B, D) one layer
+        q, k, v = _block_qkv(model, bp, h)
+        q, k = model._rope(q, k, positions)
+        # gather the prefix chain: (Pb, H, B, D) -> (1, H, Pb*B, D)
+        kp = kc[blocks].transpose(1, 0, 2, 3).reshape(
+            1, kc.shape[1], pb * B, kc.shape[3])
+        vp = vc[blocks].transpose(1, 0, 2, 3).reshape(
+            1, vc.shape[1], pb * B, vc.shape[3])
+        o = dot_product_attention(q, jnp.concatenate([kp, k], axis=2),
+                                  jnp.concatenate([vp, v], axis=2),
+                                  mask=mask)
+        h = _finish_block(model, bp, h, o)
+        return h, (k, v)
+
+    h, (k, v) = lax.scan(body, h, (params["blocks"], k_arena, v_arena))
+    h = lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)
+    h = model._layer_norm(params["ln_f"], h)
+    head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
+            else params["head"].astype(h.dtype))
+    logits = (h @ head)[:, 0]
+    return logits.astype(jnp.float32), k, v
+
+
+def _insert_blocks(k_arena, v_arena, k_new, v_new, block_ids):
+    """Scatter a prefilled chunk's k/v (L, 1, H, Tb, D) into arena
+    blocks (L, N, H, B, D): row i of the chunk lands in block
+    ``block_ids[i // B]`` at offset ``i % B`` (chunks always start
+    block-aligned).  ``block_ids`` is padded to ``ceil(Tb_bucket / B)``
+    with the scratch block, which absorbs the bucket-padding garbage —
+    by the time any real position in those rows is attended, decode has
+    overwritten it under the position mask."""
+    L, N, H, B, D = k_arena.shape
+    nb = block_ids.shape[0]
+    tb = k_new.shape[3]
+    pad = nb * B - tb
+    if pad:
+        padw = ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))
+        k_new = jnp.pad(k_new, padw)
+        v_new = jnp.pad(v_new, padw)
+    kb = k_new[:, 0].reshape(L, H, nb, B, D).transpose(0, 2, 1, 3, 4)
+    vb = v_new[:, 0].reshape(L, H, nb, B, D).transpose(0, 2, 1, 3, 4)
+    k_arena = k_arena.at[:, block_ids].set(kb.astype(k_arena.dtype))
+    v_arena = v_arena.at[:, block_ids].set(vb.astype(v_arena.dtype))
+    return k_arena, v_arena
+
+
+def _decode_step_paged(model, params, token, pos, tables, k_arena,
+                       v_arena):
+    """One cached decode step over S slots against PAGED caches: same
+    contract as :func:`_decode_step_slots`, but each slot's KV lives in
+    pool blocks named by its row of ``tables`` (S, M) int32 — a
+    fixed-shape operand (padded with the scratch block), so this stays
+    ONE AOT executable regardless of sequence lengths.  The new k/v
+    scatter by (block, offset) derived from ``pos``; attention gathers
+    each slot's chain back into a contiguous (M*B) context and applies
+    the identical position mask / score math as the slot engine.
+    Arenas (L, N, H, B, D) are donated by the serving engine."""
+    mha = model._mha
+    s, m = tables.shape
+    B = k_arena.shape[3]
+    ctx = m * B
+    h = params["embed"][token][:, None, :]
+    if model.pos_encoding == "learned":
+        h = h + params["pos"][pos][:, None, :]
+    positions = pos[:, None, None]
+    mask = (jnp.arange(ctx)[None, :] <= pos[:, None])[:, None, None, :]
+    # the block holding each slot's write position (idle slots carry an
+    # all-scratch table: their garbage write lands in block 0 and is
+    # never attended)
+    blk = tables[jnp.arange(s), pos // B]
+    off = pos % B
+
+    def body(carry, layer):
+        h = carry
+        bp, kc, vc = layer          # kc/vc: (N, H, B, D) one layer
+        q, k, v = _block_qkv(model, bp, h)  # (S, H, 1, D)
+        q, k = model._rope(q, k, positions)
+        kc = kc.at[blk, :, off, :].set(k[:, :, 0, :].astype(kc.dtype))
+        vc = vc.at[blk, :, off, :].set(v[:, :, 0, :].astype(vc.dtype))
+        # gather-by-table: (S, M, H, B, D) -> (S, H, M*B, D); position p
+        # maps to (p // B, p % B), so the gathered axis IS the position
+        kg = kc[tables].transpose(0, 2, 1, 3, 4).reshape(
+            s, mha.n_head, ctx, mha.head_dim)
+        vg = vc[tables].transpose(0, 2, 1, 3, 4).reshape(
+            s, mha.n_head, ctx, mha.head_dim)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            kg.astype(jnp.float32))
+        scores = scores / jnp.sqrt(jnp.float32(mha.head_dim))
+        scores = jnp.where(mask, scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", w, vg.astype(jnp.float32))
+        h = _finish_block(model, bp, h, o.astype(h.dtype))
+        return h, (kc, vc)
+
+    h, (k_arena, v_arena) = lax.scan(
+        body, h, (params["blocks"], k_arena, v_arena))
+    h = model._layer_norm(params["ln_f"], h)
+    head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
+            else params["head"].astype(h.dtype))
+    logits = (h @ head)[:, 0]
+    return logits.astype(jnp.float32), k_arena, v_arena
+
+
 def _decode_step(model, params, token, pos, k_cache, v_cache):
     """One cached decode step for a homogeneous batch: token (B,)
     0-based, pos scalar index of the position being *written* (one

@@ -26,6 +26,8 @@ from bigdl_tpu.serving.batcher import (DynamicBatcher, ServingClosed,
 from bigdl_tpu.serving.compile_cache import CompileCache
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.host_transfer import HostStager
+from bigdl_tpu.serving.kvcache import (BlockPool, PoolExhausted, RadixCache,
+                                       RequestExceedsPool)
 from bigdl_tpu.serving.lm_engine import (LMMetrics, LMServingEngine,
                                          LMStream, prefill_bucket_lengths)
 from bigdl_tpu.serving.metrics import LatencyHistogram, ServingMetrics
@@ -35,4 +37,5 @@ __all__ = [
     "ServingMetrics", "LatencyHistogram", "ServingQueueFull",
     "ServingOverloaded", "ServingClosed", "power_of_two_buckets",
     "LMServingEngine", "LMStream", "LMMetrics", "prefill_bucket_lengths",
+    "BlockPool", "RadixCache", "PoolExhausted", "RequestExceedsPool",
 ]

@@ -1,0 +1,18 @@
+"""Paged KV cache for LM serving: block arena + radix prefix sharing.
+
+- :class:`BlockPool` — one fixed-shape HBM k/v arena of
+  ``(L, num_blocks, H, block_len, D)`` blocks, host-side free list,
+  refcounted so block chains are shared copy-free.
+- :class:`RadixCache` — token-prefix trie over block chains with LRU
+  eviction of unreferenced tails; admission reuses the longest cached
+  prefix and prefills only the suffix.
+- :class:`RequestExceedsPool` / :class:`PoolExhausted` — the permanent
+  vs transient exhaustion types (reject vs defer).
+"""
+from bigdl_tpu.serving.kvcache.blocks import (SCRATCH_BLOCK, BlockPool,
+                                              PoolExhausted,
+                                              RequestExceedsPool)
+from bigdl_tpu.serving.kvcache.radix import RadixCache
+
+__all__ = ["BlockPool", "RadixCache", "PoolExhausted",
+           "RequestExceedsPool", "SCRATCH_BLOCK"]

@@ -1,0 +1,178 @@
+"""Paged KV memory: one HBM-resident block arena + host-side free list.
+
+The slot engine of PR 5 gave every decode slot a private contiguous
+``(cache_len,)`` KV region: admission had to reject any prompt longer
+than one region, and identical prompt prefixes were recomputed and
+stored once per request.  ``BlockPool`` is the vLLM-style alternative
+(PagedAttention, SOSP'23), TPU-native: KV memory is ONE device array of
+fixed-size blocks
+
+    k, v : (L, num_blocks, H, block_len, D)
+
+and a *sequence* is a host-side list of block ids (its block table).
+The device arrays never change shape — prefill scatters rows into
+blocks, decode gathers by a padded int32 block-table operand — so the
+AOT executables of the serving engine survive untouched and donation
+keeps the arena resident.  Everything dynamic (allocation, refcounts,
+sharing) lives on the host in this class, where it costs nothing per
+token.
+
+Block 0 is reserved as a **scratch** block: padded table entries and
+padded scatter targets point at it, so fixed-shape gathers/scatters
+never need a validity operand — garbage lands in (or comes from)
+scratch and is always masked by the position mask.  It is never
+allocated and never freed.
+
+Refcounts make chains shareable copy-free: a block referenced by two
+live sequences (or a sequence and the radix cache) is freed only when
+the last holder releases it.  ``alloc`` hands out blocks at refcount 1;
+``retain``/``release`` move them between holders.
+
+Exhaustion is two distinct conditions with two distinct types:
+
+- :class:`RequestExceedsPool` (a ``ValueError``): the request could
+  NEVER fit — its total block need exceeds the whole pool.  Raised at
+  admission, counted in ``serving/rejected_total``.
+- :class:`PoolExhausted` (a ``RuntimeError``): the pool is full *right
+  now*.  Transient by construction — blocks free as streams finish —
+  so the engine defers the request instead of failing it.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Sequence
+
+SCRATCH_BLOCK = 0
+
+
+class PoolExhausted(RuntimeError):
+    """Transient: no free blocks at this instant; retry after streams
+    complete or the radix cache evicts unreferenced tails."""
+
+
+class RequestExceedsPool(ValueError):
+    """Permanent: the request's total KV need (prompt + generation
+    budget, in blocks) exceeds the whole pool — it can never be
+    admitted.  Counted in ``serving/rejected_total``."""
+
+
+class BlockPool:
+    """Refcounted free-list allocator over one paged k/v arena.
+
+    Args:
+        n_layers / n_heads / head_dim: model geometry (L, H, D).
+        block_len: tokens per block (the page size).
+        num_blocks: total blocks INCLUDING the reserved scratch block 0;
+            usable capacity is ``num_blocks - 1``.
+        dtype: cache dtype (defaults to f32; the engine passes the
+            params' embed dtype).
+
+    The jnp arenas are held as ``self.k`` / ``self.v``; callers that
+    run donated executables over them reassign the attributes with the
+    donated outputs (same contract as the slot engine's resident
+    caches).
+    """
+
+    def __init__(self, *, n_layers: int, n_heads: int, head_dim: int,
+                 block_len: int, num_blocks: int, dtype=None):
+        import jax.numpy as jnp
+
+        if block_len < 1:
+            raise ValueError(f"block_len must be >= 1, got {block_len}")
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is scratch), got "
+                f"{num_blocks}")
+        self.block_len = int(block_len)
+        self.num_blocks = int(num_blocks)
+        self.shape = (int(n_layers), self.num_blocks, int(n_heads),
+                      self.block_len, int(head_dim))
+        dt = dtype if dtype is not None else jnp.float32
+        self.k = jnp.zeros(self.shape, dt)
+        self.v = jnp.zeros(self.shape, dt)
+        self.dtype = self.k.dtype
+        self._lock = threading.Lock()
+        # pop() from the tail hands out ascending ids first
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._ref = [0] * self.num_blocks
+
+    # -- capacity -------------------------------------------------------- #
+    @property
+    def capacity(self) -> int:
+        """Usable blocks (scratch excluded)."""
+        return self.num_blocks - 1
+
+    @property
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.capacity - self.free_count
+
+    @property
+    def arena_bytes(self) -> int:
+        """HBM footprint of the k + v arenas."""
+        return 2 * self.k.size * self.k.dtype.itemsize
+
+    def utilization(self) -> float:
+        return self.used_count / self.capacity if self.capacity else 0.0
+
+    def blocks_for(self, n_tokens: int) -> int:
+        """Blocks needed to hold ``n_tokens`` cache positions."""
+        return -(-int(n_tokens) // self.block_len)
+
+    # -- alloc / refcount ------------------------------------------------ #
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` blocks at refcount 1; all-or-nothing."""
+        n = int(n)
+        if n <= 0:
+            return []
+        with self._lock:
+            if n > len(self._free):
+                raise PoolExhausted(
+                    f"need {n} blocks, {len(self._free)} free "
+                    f"(capacity {self.capacity})")
+            out = [self._free.pop() for _ in range(n)]
+            for b in out:
+                self._ref[b] = 1
+        return out
+
+    def retain(self, blocks: Sequence[int]) -> None:
+        """Add one reference to each (already-live) block."""
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise ValueError(f"retain of free block {b}")
+                self._ref[b] += 1
+
+    def release(self, blocks: Sequence[int]) -> None:
+        """Drop one reference; a block at zero returns to the free
+        list."""
+        with self._lock:
+            for b in blocks:
+                if self._ref[b] <= 0:
+                    raise ValueError(f"release of free block {b}")
+                self._ref[b] -= 1
+                if self._ref[b] == 0:
+                    self._free.append(b)
+
+    def refcount(self, block: int) -> int:
+        with self._lock:
+            return self._ref[block]
+
+    # -- introspection --------------------------------------------------- #
+    def stats(self) -> dict:
+        with self._lock:
+            free = len(self._free)
+        return {
+            "num_blocks": self.num_blocks,
+            "block_len": self.block_len,
+            "capacity": self.capacity,
+            "free_blocks": free,
+            "used_blocks": self.capacity - free,
+            "utilization": ((self.capacity - free) / self.capacity
+                            if self.capacity else 0.0),
+            "arena_bytes": self.arena_bytes,
+        }

@@ -1,0 +1,178 @@
+"""Radix prefix cache: token-prefix trie over refcounted block chains.
+
+Chat-style production traffic repeats prompt heads constantly (system
+prompts, few-shot preambles, multi-turn history).  The SGLang insight
+(RadixAttention, 2023) is that a paged KV cache already stores every
+prompt's k/v in shareable units — so keep a trie from token prefixes to
+block chains, and admission can reuse the longest cached prefix
+copy-free, prefilling only the unmatched suffix.
+
+The trie here is **block-granular**: one node per full block of
+``block_len`` tokens (the node key is that block's token tuple), so a
+match is always a whole number of blocks and the reused chain can be
+handed straight to the fixed-shape block-table programs.  Matching is
+capped at ``(t - 1) // block_len`` blocks — the final prompt token is
+always prefilled so the request has logits to sample its first token
+from, exactly like a cold prefill.
+
+Reference protocol (one pool refcount per holder):
+
+- ``match`` retains every matched block on behalf of the caller (the
+  admitted sequence); the caller releases them with the rest of its
+  table when the stream finishes.
+- ``insert`` retains each block it adopts into a NEW node.  A prompt
+  whose prefix already exists in the trie keeps its duplicate private
+  blocks — the trie never swaps a live sequence's storage.
+- ``evict`` releases blocks whose ONLY reference is the trie itself
+  (refcount 1), LRU-first, leaves-first — a chain referenced by any
+  live sequence can never evict, and interior nodes only become
+  candidates once their subtree is gone.
+
+Thread model: the serving worker is the only mutator; counters are
+lock-guarded so stats/metrics reads from other threads are consistent.
+Eviction rescans the trie per freed block — fine at serving scale
+(trie size is bounded by the pool's block count).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from bigdl_tpu.serving.kvcache.blocks import BlockPool
+
+
+class _Node:
+    __slots__ = ("key", "block", "children", "parent", "last_used")
+
+    def __init__(self, key: Optional[Tuple[int, ...]], block: Optional[int],
+                 parent: Optional["_Node"], last_used: int):
+        self.key = key
+        self.block = block
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.parent = parent
+        self.last_used = last_used
+
+
+class RadixCache:
+    """Longest-prefix block reuse over a :class:`BlockPool`."""
+
+    def __init__(self, pool: BlockPool):
+        self.pool = pool
+        self.block_len = pool.block_len
+        self._lock = threading.Lock()
+        self._root = _Node(None, None, None, 0)
+        self._clock = 0
+        self.nodes = 0
+        self.lookups = 0
+        self.hits = 0
+        self.matched_tokens = 0   # == prefill tokens saved
+        self.inserted_blocks = 0
+        self.evictions = 0
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _block_key(self, tokens0, i: int) -> Tuple[int, ...]:
+        B = self.block_len
+        return tuple(int(x) for x in tokens0[i * B:(i + 1) * B])
+
+    # -- lookup ---------------------------------------------------------- #
+    def match(self, tokens0) -> List[int]:
+        """Longest cached prefix of ``tokens0`` (0-based token ids), in
+        whole blocks, capped so at least the last prompt token is left
+        to prefill.  Matched blocks are retained for the caller."""
+        t = len(tokens0)
+        cap = max(0, (t - 1) // self.block_len)
+        out: List[int] = []
+        with self._lock:
+            self.lookups += 1
+            node = self._root
+            now = self._tick()
+            for i in range(cap):
+                child = node.children.get(self._block_key(tokens0, i))
+                if child is None:
+                    break
+                child.last_used = now
+                out.append(child.block)
+                node = child
+            if out:
+                self.hits += 1
+                self.matched_tokens += len(out) * self.block_len
+                self.pool.retain(out)
+        return out
+
+    # -- admission ------------------------------------------------------- #
+    def insert(self, tokens0, blocks: List[int]) -> int:
+        """Register a prefilled chain: ``blocks[i]`` holds tokens
+        ``[i*B, (i+1)*B)`` of ``tokens0``.  Existing nodes are kept
+        (their blocks stay authoritative; the caller's duplicates stay
+        private to it); new tails are adopted with one trie reference.
+        Returns the number of nodes added."""
+        added = 0
+        with self._lock:
+            node = self._root
+            now = self._tick()
+            for i, blk in enumerate(blocks):
+                key = self._block_key(tokens0, i)
+                child = node.children.get(key)
+                if child is None:
+                    child = _Node(key, int(blk), node, now)
+                    node.children[key] = child
+                    self.pool.retain([int(blk)])
+                    self.nodes += 1
+                    self.inserted_blocks += 1
+                    added += 1
+                else:
+                    child.last_used = now
+                node = child
+        return added
+
+    # -- eviction -------------------------------------------------------- #
+    def _leaves(self) -> List[_Node]:
+        out, stack = [], list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            else:
+                out.append(n)
+        return out
+
+    def evict(self, n_blocks: int) -> int:
+        """Free up to ``n_blocks`` pool blocks by dropping LRU leaf
+        nodes whose block has no holder but the trie (refcount 1).
+        Returns how many blocks were actually freed."""
+        target = max(1, int(n_blocks))
+        freed = 0
+        with self._lock:
+            while freed < target:
+                victims = [n for n in self._leaves()
+                           if self.pool.refcount(n.block) == 1]
+                if not victims:
+                    break
+                v = min(victims, key=lambda n: n.last_used)
+                del v.parent.children[v.key]
+                self.pool.release([v.block])
+                self.nodes -= 1
+                self.evictions += 1
+                freed += 1
+        return freed
+
+    # -- introspection --------------------------------------------------- #
+    def hit_rate(self) -> Optional[float]:
+        with self._lock:
+            return (self.hits / self.lookups) if self.lookups else None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "nodes": self.nodes,
+                "lookups": self.lookups,
+                "hits": self.hits,
+                "hit_rate": (self.hits / self.lookups
+                             if self.lookups else None),
+                "prefill_tokens_saved": self.matched_tokens,
+                "inserted_blocks": self.inserted_blocks,
+                "evictions": self.evictions,
+            }

@@ -1,4 +1,4 @@
-"""LM serving: continuous batching over an HBM-resident KV cache.
+"""LM serving: continuous batching over a PAGED HBM-resident KV cache.
 
 Offline ``generate()`` decodes one homogeneous batch in lockstep: every
 prompt prefills together, every row steps together, and the batch
@@ -6,35 +6,51 @@ finishes when the SLOWEST request does — a serving workload with
 staggered arrivals and mixed lengths wastes most of its FLOPs on
 padding and waiting.  ``LMServingEngine`` is the iteration-level
 (continuous) batching alternative (Orca, OSDI'22; the throughput model
-vLLM popularized), built from three device programs that all reuse the
-slot-aware kernels in ``models/transformer/generate.py``:
+vLLM popularized), built from fixed-shape device programs over the
+paged block arena of :mod:`bigdl_tpu.serving.kvcache`:
 
-- **prefill** — one bucketed pass per new request: the prompt is padded
-  to a power-of-two length bucket and run through an AOT-compiled
-  executable from the shared :class:`CompileCache` (keyed on the
-  pytree signature ``{ids, len}`` + params quant dtype), producing the
-  first-token logits (read at the TRUE prompt end under the causal
-  mask) and the prompt's k/v rows.
-- **insert** — ``dynamic_update_slice`` of those k/v rows into a free
-  slot of the resident (L, S, H, cache_len, D) caches, between decode
-  iterations.  Donated: insert rewrites the resident buffers in place.
+- **prefill** — bucketed passes per new request through the shared
+  :class:`CompileCache`.  A cold prompt runs the plain bucketed prefill
+  (`_prefill_parts`); a prompt whose head is cached in the
+  :class:`RadixCache` prefills only the unmatched SUFFIX against the
+  cached block chain (`_prefill_suffix_parts`, one executable per
+  (suffix bucket, prefix-chain bucket)); prompts longer than the
+  largest bucket prefill in block-aligned CHUNKS — over-length requests
+  are admitted, not rejected.
+- **insert** — scatter of each chunk's k/v rows into its allocated
+  blocks of the resident (L, num_blocks, H, block_len, D) arenas,
+  donated so insert rewrites the resident buffers in place.
 - **decode** — ONE fixed-shape executable stepping all S slots, each at
-  its own position (per-slot RoPE/positions, per-slot causal mask),
-  with ``donate_argnums`` on both caches so the decode loop never
-  copies HBM-resident state.  Tokens stream back through per-request
-  :class:`LMStream` handles; EOS / max_new early-exit frees the slot
-  for the admission queue the same iteration.
+  its own position, taking a padded int32 **block-table** operand
+  (S, M) (padded entries name the scratch block) — paging changes the
+  operand, not the executable count — with ``donate_argnums`` on both
+  arenas so the decode loop never copies HBM-resident state.
+
+Sharing: the radix cache maps token prefixes to refcounted block
+chains, so concurrent requests with a common head attend the SAME
+blocks copy-free; decode always writes into a sequence's private tail
+blocks (the trie only ever holds *full prompt* blocks, and generation
+starts past them).  Pool pressure defers admissions (blocks free as
+streams finish, and the trie LRU-evicts unreferenced tails) — only a
+request whose total need exceeds the WHOLE pool is rejected, with the
+typed :class:`~bigdl_tpu.serving.kvcache.RequestExceedsPool` counted
+in ``serving/rejected_total``.
 
 Correctness: a slot's token stream is the same computation offline
-``generate()`` runs at batch 1 — padded prefill reads logits at the
-true last index (causal masking keeps padded keys invisible), decode
-masks cache positions ``> pos`` so stale rows from a previous occupant
-are overwritten before they are ever attended.  The mixed-length soak
-test asserts token-exact agreement per request.
+``generate()`` runs at batch 1 — cached prefix keys are stored
+post-RoPE (rotated once at their own positions) so the suffix prefill
+attends the identical valid key set through the identical attention
+core, and decode masks gathered positions ``> pos`` so stale or
+scratch rows are never attended.  The mixed-length soak asserts
+token-exact agreement per request, greedy and sampled, sharing on.
 
-Observability: TTFT and inter-token-latency histograms, tokens/sec
-(sliding window), slot occupancy — published as ``serving/lm/*`` in the
-process-wide registry — plus tracer spans for prefill/insert/decode.
+Observability: TTFT and inter-token-latency histograms, tokens/sec,
+slot occupancy (``serving/lm/*``) plus the paged-cache plane
+(``kvcache/*``): block utilization, prefix hit rate, prefill tokens
+saved, evictions, and the arena's HBM footprint
+(``kvcache/arena_bytes``) — all in the process-wide registry, so
+``ObsSummary`` and the SLO controller's headroom checks see cache
+memory, not just slots.
 """
 from __future__ import annotations
 
@@ -52,6 +68,8 @@ from bigdl_tpu.resilience.errors import (ServingOverloaded,
 from bigdl_tpu.serving.batcher import (ServingClosed, ServingQueueFull,
                                        count_rejection)
 from bigdl_tpu.serving.compile_cache import CompileCache
+from bigdl_tpu.serving.kvcache import (BlockPool, PoolExhausted, RadixCache,
+                                       RequestExceedsPool)
 from bigdl_tpu.utils.engine import select_platform
 
 _tracer = get_tracer()
@@ -277,9 +295,11 @@ class _Request:
 
 class _Slot:
     __slots__ = ("stream", "pos_next", "last0", "remaining", "step_idx",
-                 "temperature", "eos0", "step_keys", "last_emit_at")
+                 "temperature", "eos0", "step_keys", "last_emit_at",
+                 "blocks", "table")
 
-    def __init__(self, req: _Request, prompt_len: int, first0: int):
+    def __init__(self, req: _Request, prompt_len: int, first0: int,
+                 blocks: List[int], table: np.ndarray):
         self.stream = req.stream
         self.pos_next = prompt_len      # next cache position to write
         self.last0 = first0             # last emitted token, 0-based
@@ -289,29 +309,41 @@ class _Slot:
         self.eos0 = req.eos0
         self.step_keys = req.step_keys
         self.last_emit_at = time.perf_counter()
+        self.blocks = blocks            # one pool ref per block
+        self.table = table              # (M,) int32, scratch-padded
 
 
 # ---------------------------------------------------------------------- #
 class LMServingEngine:
-    """Serve ``TransformerLM`` generation with continuous batching.
+    """Serve ``TransformerLM`` generation with continuous batching over
+    a paged, prefix-shared KV cache.
 
     Args:
         model: a built ``TransformerLM`` (params are frozen at
             construction, like :class:`ServingEngine`).
         slots: decode batch width S — concurrent in-flight requests.
-        cache_len: per-slot KV length (default ``model.max_len``);
+        cache_len: per-REQUEST context cap (default ``model.max_len``);
             every request needs ``prompt_len + max_new <= cache_len``.
+            No longer a per-slot HBM region: KV memory is pooled.
         max_new_tokens: default generation budget per request.
         prefill_buckets: prompt-length pad buckets (default powers of
             two up to ``cache_len``); one AOT prefill executable each.
+            Prompts longer than the largest bucket prefill in
+            block-aligned chunks of it.
+        block_len: tokens per KV block (the page size).
+        num_blocks: total pool blocks including the reserved scratch
+            block (default: headroom for ``slots`` worst-case requests
+            plus a few radix-cached chains).
+        enable_prefix_cache: radix prefix sharing on admission
+            (default on; sharing never changes streamed tokens).
         temperature: default sampling temperature (0 = greedy, the
             bit-exact-vs-offline path).
         eos_id: default 1-based stop token; generation also stops at
             ``max_new``.
         max_queue: admission queue bound (``ServingQueueFull`` beyond).
         platform: optional jax platform pin.
-        donate_cache: donate k/v into decode/insert (the no-copy hot
-            path); disable only for debugging.
+        donate_cache: donate k/v arenas into decode/insert (the no-copy
+            hot path); disable only for debugging.
     """
 
     def __init__(self, model, *,
@@ -319,6 +351,9 @@ class LMServingEngine:
                  cache_len: Optional[int] = None,
                  max_new_tokens: int = 32,
                  prefill_buckets: Optional[Sequence[int]] = None,
+                 block_len: int = 16,
+                 num_blocks: Optional[int] = None,
+                 enable_prefix_cache: bool = True,
                  temperature: float = 0.0,
                  eos_id: Optional[int] = None,
                  max_queue: int = 256,
@@ -328,11 +363,9 @@ class LMServingEngine:
                  name: str = "lm"):
         select_platform(platform)
         import jax
-        import jax.numpy as jnp
-        from jax import lax
-
         from bigdl_tpu.models.transformer.generate import (
-            _decode_step_slots, _prefill_parts)
+            _decode_step_paged, _insert_blocks, _prefill_parts,
+            _prefill_suffix_parts)
         from bigdl_tpu.quant import dequantize_entry
 
         model._built()
@@ -358,17 +391,31 @@ class LMServingEngine:
         if self.prefill_buckets[-1] > self.cache_len:
             raise ValueError(
                 f"largest prefill bucket ({self.prefill_buckets[-1]}) "
-                f"exceeds cache_len ({self.cache_len}): inserted rows "
-                "must fit the slot cache")
+                f"exceeds cache_len ({self.cache_len}): a bucket longer "
+                "than the per-request context cap can never fill")
 
+        self.block_len = int(block_len)
+        # padded block-table width: every request's chain fits in M ids
+        self.table_width = -(-self.cache_len // self.block_len)
+        # over-length prompts prefill in block-aligned chunks of the
+        # largest bucket; 0 means buckets are sub-block (no chunking)
+        self._chunk_full = (self.prefill_buckets[-1]
+                            // self.block_len) * self.block_len
+        if num_blocks is None:
+            # slots worst-case chains + headroom for radix-held prefixes
+            num_blocks = 1 + (self.slots + 4) * self.table_width
         L, H, D = model.n_layers, model._mha.n_head, model._mha.head_dim
         dt = self._params["embed"].dtype
-        self._kv_shape = (L, self.slots, H, self.cache_len, D)
-        self._k = jnp.zeros(self._kv_shape, dt)
-        self._v = jnp.zeros(self._kv_shape, dt)
+        self.pool = BlockPool(n_layers=L, n_heads=H, head_dim=D,
+                              block_len=self.block_len,
+                              num_blocks=num_blocks, dtype=dt)
+        self.radix = RadixCache(self.pool) if enable_prefix_cache else None
         self._cache_dtype = dt
+        # prefix-chain pad buckets (powers of two up to the table width)
+        self._prefix_block_buckets = prefill_bucket_lengths(
+            self.table_width, min_bucket=1)
 
-        # -- the three device programs --------------------------------- #
+        # -- the device programs ---------------------------------------- #
         def _prefill_fn(params, buffers, x):
             del buffers  # part of the CompileCache signature only
             return _prefill_parts(model, dequantize_entry(params),
@@ -377,26 +424,29 @@ class LMServingEngine:
         self.prefill_cache = CompileCache(
             _prefill_fn, max_entries=max_cache_entries)
 
-        def _decode_fn(params, token, pos, kc, vc):
-            return _decode_step_slots(model, dequantize_entry(params),
-                                      token, pos, kc, vc)
+        def _prefix_prefill_fn(params, buffers, x):
+            del buffers
+            return _prefill_suffix_parts(
+                model, dequantize_entry(params), x["ids"], x["len"] - 1,
+                x["prefix_len"], x["blocks"], x["k"], x["v"])
 
-        donate = (3, 4) if donate_cache else ()
+        self.prefix_prefill_cache = CompileCache(
+            _prefix_prefill_fn, max_entries=max_cache_entries)
+
+        def _decode_fn(params, token, pos, tables, kc, vc):
+            return _decode_step_paged(model, dequantize_entry(params),
+                                      token, pos, tables, kc, vc)
+
+        donate = (4, 5) if donate_cache else ()
         self._decode_jit = jax.jit(_decode_fn, donate_argnums=donate)
         self._decode_exec = None
 
-        def _insert_fn(kc, vc, k_new, v_new, slot):
-            kc = lax.dynamic_update_slice(
-                kc, k_new.astype(kc.dtype), (0, slot, 0, 0, 0))
-            vc = lax.dynamic_update_slice(
-                vc, v_new.astype(vc.dtype), (0, slot, 0, 0, 0))
-            return kc, vc
-
         self._insert_jit = jax.jit(
-            _insert_fn, donate_argnums=(0, 1) if donate_cache else ())
+            _insert_blocks, donate_argnums=(0, 1) if donate_cache else ())
         self._insert_execs: dict = {}
 
         self.metrics = LMMetrics(self.slots).publish_to(get_registry())
+        self._publish_kv_metrics(get_registry())
 
         # -- scheduler state (worker thread owns the slots) ------------- #
         self._cv = threading.Condition()
@@ -415,12 +465,34 @@ class LMServingEngine:
             target=self._run, daemon=True, name=f"lm-serve-{name}")
         self._worker.start()
 
+    def _publish_kv_metrics(self, registry) -> None:
+        registry.register("kvcache/block_utilization",
+                          FnGauge(lambda: self.pool.utilization()),
+                          replace=True)
+        registry.register(
+            "kvcache/prefix_hit_rate",
+            FnGauge(lambda: self.radix.hit_rate()
+                    if self.radix is not None else None),
+            replace=True)
+        registry.register(
+            "kvcache/prefill_tokens_saved",
+            FnGauge(lambda: self.radix.matched_tokens
+                    if self.radix is not None else 0),
+            replace=True)
+        registry.register(
+            "kvcache/evictions",
+            FnGauge(lambda: self.radix.evictions
+                    if self.radix is not None else 0),
+            replace=True)
+        registry.gauge("kvcache/arena_bytes",
+                       unit="bytes").set(self.pool.arena_bytes)
+
     # ------------------------------------------------------------------ #
     def warmup(self) -> int:
         """AOT-compile every prefill bucket plus the decode and insert
         executables before traffic; returns the number of prefill
         executables compiled.  Warmup never executes on the resident
-        caches (it lowers against shapes), so it is safe mid-traffic."""
+        arenas (it lowers against shapes), so it is safe mid-traffic."""
         import numpy as _np
 
         inputs = [{"ids": _np.zeros((1, b), _np.int32),
@@ -432,25 +504,63 @@ class LMServingEngine:
             self._insert_compiled(b)
         return n
 
+    def warmup_prefix(self, suffix_lens: Optional[Sequence[int]] = None,
+                      prefix_blocks: Optional[Sequence[int]] = None) -> int:
+        """AOT-compile the prefix-suffix prefill executables: one per
+        (suffix bucket, prefix-chain bucket) pair.  Optional — they
+        also compile on first use — but a TTFT-sensitive deployment
+        warms them so the first shared-prefix hit doesn't pay a
+        compile.  Pass the expected unmatched-suffix lengths and cached
+        prefix block counts to warm only the combinations the traffic
+        will hit (the full cross product otherwise).  Returns the
+        number newly compiled."""
+        import numpy as _np
+
+        if suffix_lens is not None:
+            cap = self.prefill_buckets[-1]
+            sb = sorted({self.bucket_for(min(int(s), cap))
+                         for s in suffix_lens})
+        else:
+            sb = list(self.prefill_buckets)
+        if prefix_blocks is not None:
+            pbs = sorted({self._prefix_bucket_for(int(p))
+                          for p in prefix_blocks})
+        else:
+            pbs = list(self._prefix_block_buckets)
+        inputs = []
+        for b in sb:
+            for pb in pbs:
+                inputs.append({
+                    "ids": _np.zeros((1, b), _np.int32),
+                    "len": _np.int32(b),
+                    "prefix_len": _np.int32(pb * self.block_len),
+                    "blocks": _np.zeros((pb,), _np.int32),
+                    "k": self.pool.k, "v": self.pool.v})
+        return self.prefix_prefill_cache.warmup_inputs(
+            self._params, self._buffers, inputs)
+
     def _decode_compiled(self):
         if self._decode_exec is None:
             tok = np.zeros((self.slots,), np.int32)
             pos = np.zeros((self.slots,), np.int32)
+            tables = np.zeros((self.slots, self.table_width), np.int32)
             self._decode_exec = self._decode_jit.lower(
-                self._params, tok, pos, self._k, self._v).compile()
+                self._params, tok, pos, tables,
+                self.pool.k, self.pool.v).compile()
         return self._decode_exec
 
     def _insert_compiled(self, bucket: int):
         exe = self._insert_execs.get(bucket)
         if exe is None:
             import jax
-            L, S, H, C, D = self._kv_shape
+            L, N, H, B, D = self.pool.shape
+            nb = -(-bucket // B)
             sds = jax.ShapeDtypeStruct
             new = sds((L, 1, H, bucket, D), self._cache_dtype)
             exe = self._insert_jit.lower(
-                sds(self._kv_shape, self._cache_dtype),
-                sds(self._kv_shape, self._cache_dtype),
-                new, new, np.int32(0)).compile()
+                sds(self.pool.shape, self._cache_dtype),
+                sds(self.pool.shape, self._cache_dtype),
+                new, new, sds((nb,), np.int32)).compile()
             self._insert_execs[bucket] = exe
         return exe
 
@@ -462,8 +572,15 @@ class LMServingEngine:
                 return b
         raise ValueError(
             f"prompt length {prompt_len} exceeds the largest prefill "
-            f"bucket ({self.prefill_buckets[-1]}); paged prefill for "
-            "over-length prompts is a ROADMAP follow-on")
+            f"bucket ({self.prefill_buckets[-1]}) and the buckets are "
+            f"smaller than one KV block ({self.block_len}): chunked "
+            "prefill needs at least one block-aligned bucket")
+
+    def _prefix_bucket_for(self, n_blocks: int) -> int:
+        for pb in self._prefix_block_buckets:
+            if pb >= n_blocks:
+                return pb
+        return self._prefix_block_buckets[-1]
 
     def submit(self, prompt_ids, *,
                max_new_tokens: Optional[int] = None,
@@ -484,7 +601,20 @@ class LMServingEngine:
             raise ValueError(
                 f"prompt ({t}) + max_new ({max_new}) exceeds cache_len "
                 f"({self.cache_len})")
-        self.bucket_for(t)  # validates now, not at admit time
+        # the typed whole-pool rejection: a request that could NEVER be
+        # satisfied (its total block need exceeds the pool) is shed at
+        # admission and counted; anything smaller is admissible — pool
+        # pressure merely defers it until streams free blocks
+        need = self.pool.blocks_for(t + max_new)
+        if need > self.pool.capacity:
+            self.metrics.record_reject()
+            count_rejection()
+            raise RequestExceedsPool(
+                f"request needs {need} KV blocks ({t} prompt + {max_new} "
+                f"new tokens at block_len {self.block_len}); the whole "
+                f"pool holds {self.pool.capacity}")
+        if self._chunk_full == 0:
+            self.bucket_for(t)  # sub-block buckets: no chunked prefill
         temp = float(self.temperature if temperature is None
                      else temperature)
         eos = eos_id if eos_id is not None else self.eos_id
@@ -603,13 +733,25 @@ class LMServingEngine:
                            < self._slot_limit):
                         admits.append((self._free.pop(),
                                        self._queue.popleft()))
+                deferred = []
                 for slot, req in admits:
                     try:
-                        self._admit(slot, req)
+                        admitted = self._admit(slot, req)
                     except BaseException as e:  # noqa: BLE001
                         req.stream._finish(error=e)
                         with self._cv:
                             self._free.append(slot)
+                    else:
+                        if not admitted:
+                            deferred.append((slot, req))
+                if deferred:
+                    # pool pressure: requeue at the FRONT (FIFO order
+                    # preserved) and return the slots — blocks free as
+                    # active streams finish, then admission retries
+                    with self._cv:
+                        for slot, req in reversed(deferred):
+                            self._free.append(slot)
+                            self._queue.appendleft(req)
                 if self._n_active:
                     self._step()
         except BaseException as e:  # noqa: BLE001
@@ -617,17 +759,86 @@ class LMServingEngine:
             return
         self._fail_all(ServingClosed("engine closed before completion"))
 
-    def _admit(self, slot: int, req: _Request) -> None:
+    def _admit(self, slot: int, req: _Request) -> bool:
+        """Prefill + insert one request into ``slot``.  Returns False
+        (defer) when the pool can't supply its blocks right now, even
+        after evicting unreferenced radix tails."""
         t = req.prompt0.shape[0]
-        bucket = self.bucket_for(t)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :t] = req.prompt0
-        x = {"ids": ids, "len": np.int32(t)}
-        with _tracer.span("lm/prefill", cat="serve", bucket=bucket,
-                          prompt_len=t):
-            logits, k, v = self.prefill_cache(
-                self._params, self._buffers, x)
-            logits = np.asarray(logits)  # sync; (1, V) f32
+        B = self.block_len
+        need_total = self.pool.blocks_for(t + req.max_new)
+        matched: List[int] = []
+        if self.radix is not None:
+            matched = self.radix.match(req.prompt0)  # retains for us
+        n_new = need_total - len(matched)
+        try:
+            fresh = self.pool.alloc(n_new)
+        except PoolExhausted:
+            if self.radix is not None:
+                self.radix.evict(n_new - self.pool.free_count)
+            try:
+                fresh = self.pool.alloc(n_new)
+            except PoolExhausted:
+                if matched:
+                    self.pool.release(matched)
+                return False
+        blocks = matched + fresh
+        try:
+            self._prefill_into(req, blocks, slot, len(matched) * B)
+        except BaseException:
+            self.pool.release(blocks)
+            raise
+        return True
+
+    def _prefill_into(self, req: _Request, blocks: List[int], slot: int,
+                      matched_len: int) -> None:
+        t = req.prompt0.shape[0]
+        B = self.block_len
+        largest = self.prefill_buckets[-1]
+        p = matched_len
+        logits = None
+        while True:
+            rem = t - p
+            ts = rem if rem <= largest else self._chunk_full
+            bucket = self.bucket_for(ts)
+            ids = np.zeros((1, bucket), np.int32)
+            ids[0, :ts] = req.prompt0[p:p + ts]
+            with _tracer.span("lm/prefill", cat="serve", bucket=bucket,
+                              prompt_len=t, prefix_len=p):
+                if p == 0:
+                    logits, k, v = self.prefill_cache(
+                        self._params, self._buffers,
+                        {"ids": ids, "len": np.int32(ts)})
+                else:
+                    nbp = p // B
+                    pb = self._prefix_bucket_for(nbp)
+                    pblocks = np.zeros((pb,), np.int32)
+                    pblocks[:nbp] = blocks[:nbp]
+                    logits, k, v = self.prefix_prefill_cache(
+                        self._params, self._buffers,
+                        {"ids": ids, "len": np.int32(ts),
+                         "prefix_len": np.int32(p), "blocks": pblocks,
+                         "k": self.pool.k, "v": self.pool.v})
+            # scatter the chunk's k/v into its (block-aligned) blocks;
+            # bucket-padding rows land in trailing owned blocks or the
+            # scratch block, always masked until overwritten
+            nb_w = -(-bucket // B)
+            ids_w = np.zeros((nb_w,), np.int32)
+            owned = blocks[p // B:p // B + nb_w]
+            ids_w[:len(owned)] = owned
+            with _tracer.span("lm/insert", cat="serve", slot=slot,
+                              bucket=bucket):
+                self.pool.k, self.pool.v = self._insert_compiled(bucket)(
+                    self.pool.k, self.pool.v, k, v, ids_w)
+            p += ts
+            if p >= t:
+                break
+        logits = np.asarray(logits)  # sync; (1, V) f32
+        # cache the prompt's full blocks for future prefix hits (the
+        # matched head is already in the trie; only novel tails add)
+        if self.radix is not None:
+            nfull = t // B
+            if nfull:
+                self.radix.insert(req.prompt0[:nfull * B], blocks[:nfull])
         first0 = self._pick(logits[0], req.temperature, req.first_key,
                             clamp=False)
         req.stream._emit(first0 + 1)
@@ -637,14 +848,13 @@ class LMServingEngine:
                                 and first0 == req.eos0):
             req.stream._finish()
             self.metrics.record_complete()
+            self.pool.release(blocks)
             with self._cv:
                 self._free.append(slot)
             return
-        with _tracer.span("lm/insert", cat="serve", slot=slot,
-                          bucket=bucket):
-            self._k, self._v = self._insert_compiled(bucket)(
-                self._k, self._v, k, v, np.int32(slot))
-        st = _Slot(req, t, first0)
+        table = np.zeros((self.table_width,), np.int32)
+        table[:len(blocks)] = blocks
+        st = _Slot(req, t, first0, blocks, table)
         with self._cv:
             self._slots[slot] = st
             self._n_active += 1
@@ -652,18 +862,21 @@ class LMServingEngine:
     def _step(self):
         token = np.zeros((self.slots,), np.int32)
         pos = np.zeros((self.slots,), np.int32)
+        tables = np.zeros((self.slots, self.table_width), np.int32)
         active = []
         for i, st in enumerate(self._slots):
             if st is not None:
                 active.append((i, st))
                 token[i] = st.last0
                 pos[i] = st.pos_next
+                tables[i] = st.table
         if not active:
             return
         with _tracer.span("lm/decode_step", cat="serve",
                           active=len(active)):
-            logits, self._k, self._v = self._decode_compiled()(
-                self._params, token, pos, self._k, self._v)
+            logits, self.pool.k, self.pool.v = self._decode_compiled()(
+                self._params, token, pos, tables, self.pool.k,
+                self.pool.v)
             logits = np.asarray(logits)  # sync; (S, V) f32
         now = time.perf_counter()
         itls = []
@@ -692,6 +905,7 @@ class LMServingEngine:
         if freed:
             with self._cv:
                 for i in freed:
+                    self.pool.release(self._slots[i].blocks)
                     self._slots[i] = None
                     self._free.append(i)
                     self._n_active -= 1
@@ -704,6 +918,7 @@ class LMServingEngine:
             for i, st in enumerate(self._slots):
                 if st is not None:
                     pending.append(st.stream)
+                    self.pool.release(st.blocks)
                     self._slots[i] = None
                     self._free.append(i)
             self._n_active = 0
@@ -711,6 +926,21 @@ class LMServingEngine:
             s._finish(error=error)
 
     # ------------------------------------------------------------------ #
+    def kvcache_stats(self) -> dict:
+        """Pool + radix state, for stats() and headroom checks."""
+        out = self.pool.stats()
+        out["table_width"] = self.table_width
+        out["prefix_cache"] = (self.radix.stats()
+                               if self.radix is not None else None)
+        return out
+
+    def kvcache_headroom(self) -> int:
+        """How many additional WORST-CASE requests (a full
+        ``cache_len`` context each) the pool can hold right now.  The
+        SLO controller's scale-up check gates on this so added decode
+        slots are backed by cache memory, not just scheduler entries."""
+        return self.pool.free_count // self.table_width
+
     def stats(self) -> dict:
         with self._cv:
             queued = len(self._queue)
@@ -725,13 +955,16 @@ class LMServingEngine:
             "active": active,
             "queued": queued,
             "cache_len": self.cache_len,
+            "block_len": self.block_len,
             "prefill_buckets": list(self.prefill_buckets),
             "prefill_cache": self.prefill_cache.stats(),
+            "prefix_prefill_cache": self.prefix_prefill_cache.stats(),
+            "kvcache": self.kvcache_stats(),
             "metrics": self.metrics.snapshot(),
         }
 
     def cache_buffer_pointers(self) -> tuple:
-        """Device buffer addresses of the resident k/v caches (donation
+        """Device buffer addresses of the resident k/v arenas (donation
         regression hook: stable across decode steps)."""
 
         def ptr(a):
@@ -741,7 +974,7 @@ class LMServingEngine:
                 bufs = getattr(a, "device_buffers", None)
                 return bufs[0].unsafe_buffer_pointer() if bufs else None
 
-        return ptr(self._k), ptr(self._v)
+        return ptr(self.pool.k), ptr(self.pool.v)
 
     def close(self, timeout: Optional[float] = 30.0) -> None:
         """Drain: stop admitting, finish queued + in-flight requests;

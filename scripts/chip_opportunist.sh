@@ -58,6 +58,7 @@ if [ "$SMOKE" = "1" ]; then
   CONV_ARGS="--lenet-epochs 1 --lenet-records 256 --vgg-epochs 1 --vgg-records 128 --batch 32"
   SCAN_ITERS=1; SCAN_STEPS=2
   SERVE_LM_ARGS="--requests 6 --slots 2 --cache-len 64 --mean-gap-ms 5 --probes 1"
+  PREFIX_ARGS="--requests 6 --slots 2 --cache-len 96 --shared-len 32 --mean-gap-ms 5 --probes 1"
   SLO_ARGS="--loads 4,8 --duration 1.5 --chaos-duration 2 --chaos-rps 15 --slots 2 --cache-len 64"
 else
   BENCH_FLOOR=100            # a degraded-window crawl is not a result
@@ -70,6 +71,7 @@ else
   CONV_ARGS=""
   SCAN_ITERS=3; SCAN_STEPS=8
   SERVE_LM_ARGS="--requests 48 --slots 8 --cache-len 128"
+  PREFIX_ARGS="--requests 24 --slots 8 --cache-len 128 --shared-len 64"
   SLO_ARGS="--loads 4,8,16,32,64 --duration 5 --chaos-duration 8"
 fi
 
@@ -105,7 +107,7 @@ PYEOF
 # driver commits leftovers anyway.
 ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
-BENCH_LM_SERVE.json BENCH_SLO.json \
+BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
 SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
@@ -223,6 +225,25 @@ serve_lm_stage() {
   return 1
 }
 
+# prefix rides right after serve-lm: same decode hot path plus the
+# radix-sharing plane (suffix prefill + block-table gathers), still far
+# below the 32 MB relay ceiling, and gated the same way — the repo's
+# CPU-proven BENCH_PREFIX.json must never mark the TPU stage done, and
+# the stage never gates the round's exit or the scaling regen.
+prefix_stage() {
+  ok_lm BENCH_PREFIX.json && return 0
+  say "stage prefix: firing (budget 600s): python -u bench.py --serve-lm --prefix $PREFIX_ARGS"
+  timeout 600 python -u bench.py --serve-lm --prefix $PREFIX_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm BENCH_PREFIX.json; then
+    say "stage prefix: DONE"
+    return 0
+  fi
+  say "stage prefix: not done (rc=$rc)"
+  record_incident prefix "$rc"
+  return 1
+}
+
 # slo rides right after serve-lm: the traffic harness sweeps offered
 # load over the same decode hot path and replays the round's OWN
 # incident log (TUNNEL_INCIDENTS.json) as mid-load chaos.  Same
@@ -307,6 +328,7 @@ while :; do
     BIGDL_TPU_BENCH_INNER=1 BIGDL_TPU_BENCH_ITERS=$BENCH_ITERS \
       run_stage bench BENCH_LAST.json 420 python -u bench.py
     serve_lm_stage
+    prefix_stage
     slo_stage
     # dispatch-overhead experiment: same step, SCAN_STEPS per device
     # call (the scan variant never writes BENCH_LAST — different

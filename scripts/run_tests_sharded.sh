@@ -12,9 +12,11 @@ N=${N:-3}
 # Fast resilience gate first (FAULTS_GATE=0 skips): the fault matrix is
 # small and tier-1, and a broken retry/failover/resume path should fail
 # the run in seconds, before the full shards spend their minutes.
+# test_kvcache.py carries the pool-exhaustion faults (typed rejection
+# vs deferral) — KV memory pressure is a first-class fault domain.
 if [ "${FAULTS_GATE:-1}" = "1" ]; then
   python -m pytest tests/test_resilience.py tests/test_traffic.py \
-    -q -m faults || exit 1
+    tests/test_kvcache.py -q -m faults || exit 1
 fi
 
 files=(tests/test_*.py)

@@ -73,16 +73,19 @@ def test_prefill_bucket_lengths():
     assert prefill_bucket_lengths(5) == (5,)
 
 
-def test_bucket_selection_and_overflow(lm_engine):
+def test_bucket_selection_and_overflow(lm_engine, lm_model):
     assert lm_engine.bucket_for(1) == 4
     assert lm_engine.bucket_for(4) == 4
     assert lm_engine.bucket_for(5) == 8
     assert lm_engine.bucket_for(16) == 16
-    with pytest.raises(ValueError):
-        lm_engine.bucket_for(17)  # paged prefill is a follow-on
-    with pytest.raises(ValueError):
-        # validated at submit, before the request is accepted
-        lm_engine.submit(np.arange(1, 19))
+    # ACCEPTANCE: a prompt longer than the largest prefill bucket (the
+    # old per-slot cache region) is admitted — chunked paged prefill —
+    # and served bit-exact vs offline generate
+    p = np.arange(1, 19)  # 18 > largest bucket 16
+    out = lm_engine.generate(p, max_new_tokens=6, timeout=120)
+    ref = np.asarray(generate(lm_model, lm_model.params,
+                              p[None].astype(np.int32), 6))
+    np.testing.assert_array_equal(out, ref[0])
 
 
 def test_submit_rejects_over_cache_len(lm_engine):
@@ -226,6 +229,37 @@ def test_sampled_parity_with_offline(lm_model):
                 lm_model, lm_model.params, p[None].astype(np.int32), 3,
                 temperature=0.7, rng=jax.random.PRNGKey(seed)))
             np.testing.assert_array_equal(out, ref[0])
+    finally:
+        eng.close()
+
+
+def test_prefix_sharing_greedy_and_sampled_exact(lm_model):
+    """ACCEPTANCE: with paging + radix sharing ON and a prefix actually
+    reused (hit rate > 0), greedy AND sampled streams stay bit-exact vs
+    offline generate — sharing changes memory traffic, never tokens."""
+    import jax
+    eng = LMServingEngine(lm_model, slots=2, cache_len=24, block_len=4,
+                          prefill_buckets=(4, 8, 16))
+    try:
+        p = np.arange(1, 13)  # 12 tokens = 3 full blocks, 2 matchable
+        ref = np.asarray(generate(lm_model, lm_model.params,
+                                  p[None].astype(np.int32), 6))[0]
+        np.testing.assert_array_equal(
+            eng.generate(p, max_new_tokens=6, timeout=120), ref)
+        hits0 = eng.radix.hits
+        # identical prompt: served THROUGH the shared chain, still exact
+        np.testing.assert_array_equal(
+            eng.generate(p, max_new_tokens=6, timeout=120), ref)
+        assert eng.radix.hits == hits0 + 1
+        assert eng.radix.matched_tokens >= 8
+        # sampled: the replayed key chain survives the prefix-hit path
+        sref = np.asarray(generate(
+            lm_model, lm_model.params, p[None].astype(np.int32), 6,
+            temperature=0.7, rng=jax.random.PRNGKey(7)))[0]
+        out = eng.generate(p, max_new_tokens=6, temperature=0.7, rng=7,
+                           timeout=120)
+        np.testing.assert_array_equal(out, sref)
+        assert eng.radix.hits == hits0 + 2
     finally:
         eng.close()
 
