@@ -900,7 +900,9 @@ def _lm_workload(n_requests: int, vocab: int, mean_gap_ms: float, rng):
 
 def _serve_lm_stage_continuous(eng, model, work, probes: int) -> dict:
     """Replay the arrival schedule against the continuous-batching
-    engine; every latency number is measured client-side."""
+    engine; every latency number is measured client-side except slot
+    occupancy (mean/peak), which comes from the engine's own
+    decode-step gauge."""
     import numpy as np
     from bigdl_tpu.models.transformer.generate import generate
 
@@ -933,8 +935,13 @@ def _serve_lm_stage_continuous(eng, model, work, probes: int) -> dict:
                        if snap["itl"]["p50_s"] is not None else None),
         "itl_p99_ms": (round(snap["itl"]["p99_s"] * 1000.0, 3)
                        if snap["itl"]["p99_s"] is not None else None),
-        "slot_occupancy": (round(snap["slot_occupancy"], 4)
-                           if snap["slot_occupancy"] is not None else None),
+        "decode_attn": eng.decode_attn,
+        "slot_occupancy_mean": (round(snap["slot_occupancy"], 4)
+                                if snap["slot_occupancy"] is not None
+                                else None),
+        "slot_occupancy_peak": (round(snap["slot_occupancy_peak"], 4)
+                                if snap["slot_occupancy_peak"] is not None
+                                else None),
         "agreement_probes": probes,
         "agreement": round(exact / probes, 4) if probes else None,
     }
@@ -1029,6 +1036,7 @@ def _serve_lm_bench(argv) -> int:
               "pos": "rope", "slots": args.slots,
               "cache_len": args.cache_len,
               "layout": "paged", "block_len": args.block_len,
+              "decode_attn": ["gather", "paged_kernel"],
               "requests": args.requests,
               "mean_gap_ms": args.mean_gap_ms,
               "prompt_lens": list(_LM_PROMPT_LENS),
@@ -1058,7 +1066,27 @@ def _serve_lm_bench(argv) -> int:
     eng = LMServingEngine(model, slots=args.slots,
                           cache_len=args.cache_len,
                           block_len=args.block_len,
-                          max_queue=max(args.requests, 256))
+                          max_queue=max(args.requests, 256),
+                          decode_attn="gather")
+
+    def _paged_kernel_stage():
+        """Same trace through a second engine whose decode attention is
+        the Pallas paged kernel (in-place block-table reads instead of
+        the dense kc[tables] gather) — tokens/s + the same exactness
+        probes, so the row certifies the kernel is token-exact too."""
+        eng2 = LMServingEngine(model, slots=args.slots,
+                               cache_len=args.cache_len,
+                               block_len=args.block_len,
+                               max_queue=max(args.requests, 256),
+                               decode_attn="paged_kernel",
+                               name="lm-paged-kernel")
+        try:
+            eng2.warmup()
+            return _serve_lm_stage_continuous(eng2, model, work,
+                                              args.probes)
+        finally:
+            eng2.close()
+
     try:
         t0 = time.perf_counter()
         compiled = eng.warmup()
@@ -1071,6 +1099,7 @@ def _serve_lm_bench(argv) -> int:
         stages = {
             "continuous": lambda: _serve_lm_stage_continuous(
                 eng, model, work, args.probes),
+            "continuous_paged_kernel": _paged_kernel_stage,
             "static_baseline": lambda: _serve_lm_stage_static(model, work),
         }
         for name, run in stages.items():
@@ -1085,18 +1114,28 @@ def _serve_lm_bench(argv) -> int:
             flush()
 
         cont = next(r for r in rows if r.get("stage") == "continuous")
+        paged = next(r for r in rows
+                     if r.get("stage") == "continuous_paged_kernel")
         stat = next(r for r in rows
                     if r.get("stage") == "static_baseline")
         speedup = (cont["tokens_per_s"] / stat["tokens_per_s"]
                    if stat["tokens_per_s"] else None)
+        kern_speedup = (paged["tokens_per_s"] / cont["tokens_per_s"]
+                        if cont["tokens_per_s"] else None)
         result["summary"] = {
             "ttft_p50_ms": cont["ttft"]["p50_ms"],
             "ttft_p99_ms": cont["ttft"]["p99_ms"],
             "itl_p50_ms": cont["itl_p50_ms"],
             "itl_p99_ms": cont["itl_p99_ms"],
             "tokens_per_s": cont["tokens_per_s"],
-            "slot_occupancy": cont["slot_occupancy"],
+            "slot_occupancy_mean": cont["slot_occupancy_mean"],
+            "slot_occupancy_peak": cont["slot_occupancy_peak"],
             "agreement": cont["agreement"],
+            "paged_kernel_tokens_per_s": paged["tokens_per_s"],
+            "paged_kernel_agreement": paged["agreement"],
+            "paged_kernel_vs_gather": (round(kern_speedup, 3)
+                                       if kern_speedup is not None
+                                       else None),
             "static_tokens_per_s": stat["tokens_per_s"],
             "static_ttft_p50_ms": stat["ttft"]["p50_ms"],
             "continuous_speedup": (round(speedup, 3)
@@ -1634,6 +1673,82 @@ def _slo_bench(argv) -> int:
         eng.close()
 
 
+# ---------------------------------------------------------------------------
+# --attn: block-size autotune sweep + BENCH_ATTN regeneration
+# ---------------------------------------------------------------------------
+
+
+def _attn_bench(argv) -> int:
+    """Attention-kernel measurement stage: optionally run the resumable
+    block-size autotuner (``--autotune`` -> TUNE_ATTN.json winners per
+    device kind, plus the paged-decode kernel/gather duel with
+    ``--paged``), then regenerate BENCH_ATTN.json with the tuned blocks
+    (``--useTuned``) so the headline flash-vs-XLA speedup reflects the
+    kernel users actually get through the crossover dispatcher."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --attn")
+    ap.add_argument("--sweep", default="2048",
+                    help="comma-separated seq lens")
+    ap.add_argument("--headDim", type=int, default=128)
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("-b", "--batch", type=int, default=1)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--iters", type=int, default=3)
+    ap.add_argument("--autotune", action="store_true",
+                    help="run the (block_q, block_k) sweep before the "
+                         "BENCH_ATTN regeneration")
+    ap.add_argument("--grid", default=None,
+                    help="candidate tiles as 'bq:bk,bq:bk,...' "
+                         "(default: autotune.DEFAULT_GRID)")
+    ap.add_argument("--paged", action="store_true",
+                    help="also duel the paged-decode kernel against the "
+                         "dense gather")
+    ap.add_argument("--paged-iters", type=int, default=20)
+    ap.add_argument("--block-len", type=int, default=16,
+                    help="KV page size for the paged-decode duel")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--cache-len", type=int, default=2048)
+    ap.add_argument("--json", default=None,
+                    help="BENCH_ATTN output path (default: repo root)")
+    args = ap.parse_args(argv)
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    from bigdl_tpu.ops import autotune
+
+    seq_lens = [int(s) for s in args.sweep.split(",")]
+    if args.autotune:
+        grid = (autotune.parse_grid(args.grid) if args.grid
+                else autotune.DEFAULT_GRID)
+        autotune.autotune_attention(
+            seq_lens, head_dim=args.headDim, dtype=args.dtype,
+            causal=True, batch=args.batch, heads=args.heads,
+            iters=args.iters, grid=grid, finalize=not args.paged)
+        if args.paged:
+            autotune.autotune_paged_decode(
+                slots=args.slots, heads=args.heads,
+                head_dim=args.headDim, cache_len=args.cache_len,
+                block_len=args.block_len, dtype=args.dtype,
+                iters=args.paged_iters, finalize=True)
+
+    from bigdl_tpu.models.utils import attention_bench
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_ATTN.json")
+    attention_bench.main(
+        ["--sweep", ",".join(str(t) for t in seq_lens),
+         "--naive", "--useTuned",
+         "--headDim", str(args.headDim),
+         "--dtype", args.dtype,
+         "-b", str(args.batch),
+         "--heads", str(args.heads),
+         "--iters", str(args.iters),
+         "--json", args.json])
+    return 0
+
+
 if __name__ == "__main__":
     if ("--trace" in sys.argv and "--serve" not in sys.argv
             and "--serve-lm" not in sys.argv):
@@ -1642,6 +1757,8 @@ if __name__ == "__main__":
         # down as BIGDL_TPU_TRACE and strip it here
         sys.argv = [a for a in sys.argv if a != "--trace"]
         os.environ["BIGDL_TPU_TRACE"] = "1"
+    if "--attn" in sys.argv:
+        sys.exit(_attn_bench([a for a in sys.argv[1:] if a != "--attn"]))
     if "--slo" in sys.argv:
         sys.exit(_slo_bench([a for a in sys.argv[1:] if a != "--slo"]))
     if "--serve-lm" in sys.argv and "--prefix" in sys.argv:

@@ -75,11 +75,15 @@ def _prefill_parts(model, params, ids0, last_index):
         # resolver (flash keeps the (T, T) matrix out of HBM for long
         # prompts, exactly as in TransformerLM._block — including the
         # "auto" crossover rule)
-        if model._mha.resolve_use_flash(q.shape[-2]):
+        if model._mha.resolve_use_flash(q.shape[-2], dtype=q.dtype):
             from bigdl_tpu.ops import flash_attention
-            bs = model._mha.block_size or 128
-            o = flash_attention(q, k, v, causal=True, block_q=bs,
-                                block_k=bs)
+            if model._mha.attention_impl == "flash" or model._mha.block_size:
+                bs = model._mha.block_size or 128
+                o = flash_attention(q, k, v, causal=True, block_q=bs,
+                                    block_k=bs)
+            else:
+                # "auto": blocks stay None -> tuned-crossover plan
+                o = flash_attention(q, k, v, causal=True)
         else:
             from bigdl_tpu.nn.attention import dot_product_attention
             o = dot_product_attention(q, k, v, causal=True)
@@ -236,16 +240,23 @@ def _insert_blocks(k_arena, v_arena, k_new, v_new, block_ids):
 
 
 def _decode_step_paged(model, params, token, pos, tables, k_arena,
-                       v_arena):
+                       v_arena, *, attn_impl: str = "gather"):
     """One cached decode step over S slots against PAGED caches: same
     contract as :func:`_decode_step_slots`, but each slot's KV lives in
     pool blocks named by its row of ``tables`` (S, M) int32 — a
     fixed-shape operand (padded with the scratch block), so this stays
     ONE AOT executable regardless of sequence lengths.  The new k/v
-    scatter by (block, offset) derived from ``pos``; attention gathers
-    each slot's chain back into a contiguous (M*B) context and applies
-    the identical position mask / score math as the slot engine.
-    Arenas (L, N, H, B, D) are donated by the serving engine."""
+    scatter by (block, offset) derived from ``pos``; attention reads
+    each slot's chain under the identical position mask / score math as
+    the slot engine — either by gathering it into a dense (S, H, M*B, D)
+    view (``attn_impl="gather"``, the XLA baseline) or in place via the
+    Pallas block-table kernel (``attn_impl="paged_kernel"``,
+    ``ops.paged_attention`` — same f32 softmax formulation, so streams
+    stay token-exact across the two).  Arenas (L, N, H, B, D) are
+    donated by the serving engine."""
+    if attn_impl not in ("gather", "paged_kernel"):
+        raise ValueError(f"attn_impl must be 'gather' or 'paged_kernel', "
+                         f"got {attn_impl!r}")
     mha = model._mha
     s, m = tables.shape
     B = k_arena.shape[3]
@@ -268,18 +279,25 @@ def _decode_step_paged(model, params, token, pos, tables, k_arena,
         q, k = model._rope(q, k, positions)
         kc = kc.at[blk, :, off, :].set(k[:, :, 0, :].astype(kc.dtype))
         vc = vc.at[blk, :, off, :].set(v[:, :, 0, :].astype(vc.dtype))
-        # gather-by-table: (S, M, H, B, D) -> (S, H, M*B, D); position p
-        # maps to (p // B, p % B), so the gathered axis IS the position
-        kg = kc[tables].transpose(0, 2, 1, 3, 4).reshape(
-            s, mha.n_head, ctx, mha.head_dim)
-        vg = vc[tables].transpose(0, 2, 1, 3, 4).reshape(
-            s, mha.n_head, ctx, mha.head_dim)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                            kg.astype(jnp.float32))
-        scores = scores / jnp.sqrt(jnp.float32(mha.head_dim))
-        scores = jnp.where(mask, scores, -1e30)
-        w = jax.nn.softmax(scores, axis=-1)
-        o = jnp.einsum("bhqk,bhkd->bhqd", w, vg.astype(jnp.float32))
+        if attn_impl == "paged_kernel":
+            # in-place block reads via the table (no kc[tables] dense
+            # materialization); numerics identical to the gather below
+            from bigdl_tpu.ops import paged_decode_attention
+            o = paged_decode_attention(q, kc, vc, tables, pos)
+        else:
+            # gather-by-table: (S, M, H, B, D) -> (S, H, M*B, D);
+            # position p maps to (p // B, p % B), so the gathered axis
+            # IS the position
+            kg = kc[tables].transpose(0, 2, 1, 3, 4).reshape(
+                s, mha.n_head, ctx, mha.head_dim)
+            vg = vc[tables].transpose(0, 2, 1, 3, 4).reshape(
+                s, mha.n_head, ctx, mha.head_dim)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                                kg.astype(jnp.float32))
+            scores = scores / jnp.sqrt(jnp.float32(mha.head_dim))
+            scores = jnp.where(mask, scores, -1e30)
+            w = jax.nn.softmax(scores, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", w, vg.astype(jnp.float32))
         h = _finish_block(model, bp, h, o.astype(h.dtype))
         return h, (kc, vc)
 

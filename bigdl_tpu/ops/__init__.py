@@ -7,5 +7,9 @@ schedule.  Every op has a pure-XLA fallback; kernels run in interpreter
 mode off-TPU so the test suite exercises them on CPU.
 """
 from bigdl_tpu.ops.flash_attention import (  # noqa: F401
-    flash_attention, flash_attention_with_lse,
+    AttentionPlan, flash_attention, flash_attention_with_lse,
+    resolve_attention_plan,
+)
+from bigdl_tpu.ops.paged_attention import (  # noqa: F401
+    paged_decode_attention, paged_decode_attention_reference,
 )

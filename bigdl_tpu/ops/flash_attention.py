@@ -25,7 +25,7 @@ from __future__ import annotations
 import functools
 import math
 import os
-from typing import Optional
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -511,21 +511,76 @@ _flash_lse.defvjp(_flash_lse_vjp_fwd, _flash_lse_vjp_bwd)
 FLASH_AUTO_MIN_T = int(os.environ.get("BIGDL_TPU_FLASH_MIN_T", "4096"))
 
 
-def use_flash_auto(seq_len: int) -> bool:
-    """The "auto" dispatch rule: Pallas flash iff running on a real TPU
-    backend AND the sequence is past the crossover (interpreter-mode
-    flash on CPU is a correctness tool, never a speed win)."""
+def use_flash_auto(seq_len: int, head_dim: Optional[int] = None,
+                   dtype=None, causal: bool = True) -> bool:
+    """The "auto" dispatch rule.  With a full config, a tuned verdict
+    from the autotune cache (measured ON THIS device kind) overrides
+    everything; otherwise the static heuristic: Pallas flash iff running
+    on a real TPU backend AND the sequence is past the crossover
+    (interpreter-mode flash on CPU is a correctness tool, never a speed
+    win)."""
+    if head_dim is not None and dtype is not None:
+        from bigdl_tpu.ops import autotune
+        entry = autotune.lookup(seq_len, head_dim, dtype, causal)
+        if entry is not None and entry.use_flash is not None:
+            return entry.use_flash
     return jax.default_backend() == "tpu" and seq_len >= FLASH_AUTO_MIN_T
+
+
+class AttentionPlan(NamedTuple):
+    """Resolved dispatch for one attention call (observability + tests)."""
+    impl: str           # "flash" | "xla"
+    block_q: Optional[int]
+    block_k: Optional[int]
+    source: str         # "pinned" | "tuned" | "default"
+
+
+def resolve_attention_plan(seq_len_k: int, head_dim: int, dtype,
+                           causal: bool, *,
+                           block_q: Optional[int] = None,
+                           block_k: Optional[int] = None) -> AttentionPlan:
+    """The crossover rule behind ``flash_attention``: explicit blocks pin
+    the kernel (tests, the autotuner itself); otherwise the tuning cache
+    decides — a tuned loss to naive XLA routes to the XLA fallback so
+    callers can never regress below the baseline, a tuned win supplies
+    the winning blocks, and no verdict keeps the 128x128 status quo."""
+    if block_q is not None or block_k is not None:
+        return AttentionPlan("flash", int(block_q or 128),
+                             int(block_k or 128), "pinned")
+    from bigdl_tpu.ops import autotune
+    entry = autotune.lookup(seq_len_k, head_dim, dtype, causal)
+    if entry is not None and entry.use_flash is not None:
+        if not entry.use_flash:
+            return AttentionPlan("xla", None, None, "tuned")
+        return AttentionPlan("flash", int(entry.block_q or 128),
+                             int(entry.block_k or 128), "tuned")
+    return AttentionPlan("flash", 128, 128, "default")
+
+
+def _xla_fallback(q, k, v, causal, scale, segment_ids):
+    from bigdl_tpu.nn.attention import dot_product_attention, segment_mask
+    mask = None
+    if segment_ids is not None:
+        mask = segment_mask(segment_ids, segment_ids)
+    return dot_product_attention(q, k, v, causal=causal, mask=mask,
+                                 scale=scale)
 
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     scale: Optional[float] = None,
                     segment_ids=None,
-                    block_q: int = 128, block_k: int = 128):
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None):
     """Tiled flash attention.  q: (B, H, Tq, D); k, v: (B, H, Tk, D) — D
     should be a multiple of 128 for MXU-aligned tiles (smaller D works at
     reduced efficiency).  Runs the Pallas kernel on TPU, interpreter mode
     elsewhere; differentiable via the recomputation backward.
+
+    Block sizes left as None engage the crossover dispatcher
+    (``resolve_attention_plan``): tuned winner blocks from TUNE_ATTN.json
+    when this device kind has been autotuned, the naive-XLA fused path
+    whenever the tuned flash time lost to it, 128x128 otherwise.
+    Passing explicit block sizes pins the Pallas kernel.
 
     ``segment_ids`` (B, T) int: packed-document isolation for
     self-attention — position i attends position j only when their ids
@@ -537,8 +592,12 @@ def flash_attention(q, k, v, *, causal: bool = False,
         scale = 1.0 / math.sqrt(q.shape[-1])
     if segment_ids is not None and q.shape[-2] != k.shape[-2]:
         raise ValueError("segment_ids requires self-attention (Tq == Tk)")
+    plan = resolve_attention_plan(k.shape[-2], q.shape[-1], q.dtype,
+                                  causal, block_q=block_q, block_k=block_k)
+    if plan.impl == "xla":
+        return _xla_fallback(q, k, v, causal, float(scale), segment_ids)
     return _flash(q, k, v, segment_ids, segment_ids, causal, float(scale),
-                  int(block_q), int(block_k))
+                  plan.block_q, plan.block_k)
 
 
 def flash_attention_with_lse(q, k, v, *, causal: bool = False,

@@ -196,6 +196,7 @@ class LMMetrics:
         self.decode_steps = 0
         self.slot_steps = 0
         self.active_slot_steps = 0
+        self.peak_active = 0
         self.started_at = time.perf_counter()
         self._window_s = float(throughput_window_s)
         self._recent: deque = deque()  # (t, n_tokens) per decode step
@@ -215,6 +216,10 @@ class LMMetrics:
         registry.register(
             prefix + "slot_occupancy",
             FnGauge(lambda: self.snapshot()["slot_occupancy"]),
+            replace=True)
+        registry.register(
+            prefix + "slot_occupancy_peak",
+            FnGauge(lambda: self.snapshot()["slot_occupancy_peak"]),
             replace=True)
         return self
 
@@ -240,6 +245,7 @@ class LMMetrics:
             self.decode_steps += 1
             self.slot_steps += self.slots
             self.active_slot_steps += n_active
+            self.peak_active = max(self.peak_active, n_active)
             self.tokens += len(itls_s)
             self._recent.append((now, len(itls_s)))
             horizon = now - self._window_s
@@ -271,6 +277,9 @@ class LMMetrics:
                 "tokens_per_s": (windowed / span) if span > 0 else 0.0,
                 "slot_occupancy":
                     (self.active_slot_steps / self.slot_steps)
+                    if self.slot_steps else None,
+                "slot_occupancy_peak":
+                    (self.peak_active / self.slots)
                     if self.slot_steps else None,
                 "ttft": self.ttft.snapshot(),
                 "itl": self.itl.snapshot(),
@@ -344,6 +353,13 @@ class LMServingEngine:
         platform: optional jax platform pin.
         donate_cache: donate k/v arenas into decode/insert (the no-copy
             hot path); disable only for debugging.
+        decode_attn: decode attention over the paged cache —
+            "gather" (dense kc[tables] materialization, the XLA
+            baseline), "paged_kernel" (the in-place Pallas block-table
+            kernel, ``ops.paged_attention``), or "auto" (default): the
+            kernel only when the autotune cache has measured it faster
+            than the gather ON THIS device kind, the gather otherwise.
+            Both produce token-identical streams.
     """
 
     def __init__(self, model, *,
@@ -360,6 +376,7 @@ class LMServingEngine:
                  max_cache_entries: int = 16,
                  platform: Optional[str] = None,
                  donate_cache: bool = True,
+                 decode_attn: str = "auto",
                  name: str = "lm"):
         select_platform(platform)
         import jax
@@ -433,9 +450,24 @@ class LMServingEngine:
         self.prefix_prefill_cache = CompileCache(
             _prefix_prefill_fn, max_entries=max_cache_entries)
 
+        if decode_attn not in ("auto", "gather", "paged_kernel"):
+            raise ValueError(f"decode_attn must be 'auto', 'gather' or "
+                             f"'paged_kernel', got {decode_attn!r}")
+        if decode_attn == "auto":
+            # the same crossover discipline as flash_attention: the
+            # kernel only on tuned evidence for this device kind, the
+            # proven XLA gather otherwise
+            from bigdl_tpu.ops import autotune
+            tuned = autotune.lookup_paged(D, self.block_len, dt)
+            decode_attn = ("paged_kernel"
+                           if tuned is not None and tuned.use_kernel
+                           else "gather")
+        self.decode_attn = decode_attn
+
         def _decode_fn(params, token, pos, tables, kc, vc):
             return _decode_step_paged(model, dequantize_entry(params),
-                                      token, pos, tables, kc, vc)
+                                      token, pos, tables, kc, vc,
+                                      attn_impl=decode_attn)
 
         donate = (4, 5) if donate_cache else ()
         self._decode_jit = jax.jit(_decode_fn, donate_argnums=donate)
@@ -956,6 +988,7 @@ class LMServingEngine:
             "queued": queued,
             "cache_len": self.cache_len,
             "block_len": self.block_len,
+            "decode_attn": self.decode_attn,
             "prefill_buckets": list(self.prefill_buckets),
             "prefill_cache": self.prefill_cache.stats(),
             "prefix_prefill_cache": self.prefix_prefill_cache.stats(),

@@ -19,6 +19,21 @@ if [ "${FAULTS_GATE:-1}" = "1" ]; then
     tests/test_kvcache.py -q -m faults || exit 1
 fi
 
+# Artifact schema lint: committed BENCH_*/TUNE_*/PROFILE_* files are
+# the evidence chain — a truncated or key-drifted one fails silently
+# downstream (resume identity never matches, regen skips rows), so it
+# should fail loudly here, in seconds.
+python scripts/validate_artifact.py || exit 1
+
+# Kernel correctness gate: the attention crossover + paged-decode
+# kernel and the autotune cache are dispatch-critical (a bad verdict
+# silently reroutes every "auto" attention call) — fail fast before
+# the full shards spend their minutes.
+if [ "${ATTN_GATE:-1}" = "1" ]; then
+  python -m pytest tests/test_paged_attention.py \
+    tests/test_autotune_attention.py -q -m "not slow" || exit 1
+fi
+
 files=(tests/test_*.py)
 pids=()
 for i in $(seq 0 $((N - 1))); do

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Schema lint for committed measurement artifacts.
+
+Every BENCH_*/TUNE_*/PROFILE_* JSON in the repo root is part of the
+evidence chain the round-end driver and the scaling regeneration
+consume — a truncated or key-drifted artifact fails SILENTLY there
+(rows skipped, resume identity never matching, `complete` read as
+falsy).  This linter makes the contract explicit and cheap to check:
+
+  * the file parses as JSON — or as JSON-LINES, which BENCH_SMOKE.json
+    legitimately is (one metric record per line);
+  * supervisor records (BENCH_r<round>*.json: {'n','cmd','rc',...})
+    carry their replay keys;
+  * row-carrying artifacts carry a boolean ``complete`` (the resumable
+    contract: false until the final flush), a platform tag
+    (``platform`` or ``inner_platform`` — rows without one can be
+    mistaken for chip numbers), and a list-of-dicts ``rows`` section;
+  * anything else must at least self-identify with a ``metric`` key.
+
+Usage:
+    python scripts/validate_artifact.py            # lint the repo root
+    python scripts/validate_artifact.py FILE...    # lint specific files
+
+Exit 0 when every artifact passes, 1 otherwise (missing files named on
+the command line are an error; an empty repo-root glob is not).
+"""
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: repo-root artifact families under the resumable-measurement contract
+PATTERNS = ("BENCH_*.json", "TUNE_*.json", "PROFILE_*.json")
+
+
+def _problems(doc) -> list:
+    """Contract violations for one parsed artifact document."""
+    probs = []
+    if isinstance(doc, list):  # JSONL: every record self-identifies
+        for i, rec in enumerate(doc):
+            if not isinstance(rec, dict) or "metric" not in rec:
+                probs.append("jsonl record %d lacks a 'metric' key" % i)
+        return probs
+    if not isinstance(doc, dict):
+        return ["top level is %s, expected object" % type(doc).__name__]
+    if "cmd" in doc and "rc" in doc:
+        return probs  # supervisor replay record — cmd+rc is the contract
+    if "rows" in doc or "measurements" in doc:
+        section = "rows" if "rows" in doc else "measurements"
+        if not isinstance(doc.get("complete"), bool):
+            probs.append("missing boolean 'complete' "
+                         "(resumable-artifact contract)")
+        if not any(k in doc for k in ("platform", "inner_platform")):
+            probs.append("missing platform tag "
+                         "('platform' or 'inner_platform')")
+        rows = doc[section]
+        if not isinstance(rows, list):
+            probs.append("'%s' is not a list" % section)
+        elif not all(isinstance(r, dict) for r in rows):
+            probs.append("'%s' holds non-object entries" % section)
+        return probs
+    if "metric" not in doc:
+        probs.append("no 'rows', no supervisor record, no 'metric' key "
+                     "— unidentifiable artifact")
+    return probs
+
+
+def validate(path: str) -> list:
+    """Problems for one file ([] = clean)."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        return ["unreadable: %s" % e]
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        # JSON-LINES fallback (e.g. BENCH_SMOKE.json): every non-blank
+        # line must parse on its own
+        recs = []
+        for i, line in enumerate(text.splitlines()):
+            if not line.strip():
+                continue
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                return ["neither JSON nor JSON-LINES (line %d: %s)"
+                        % (i + 1, e)]
+        if not recs:
+            return ["empty file"]
+        doc = recs
+    return _problems(doc)
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv:
+        paths = argv
+        missing = [p for p in paths if not os.path.exists(p)]
+        if missing:
+            for p in missing:
+                print("validate_artifact: %s: missing" % p)
+            return 1
+    else:
+        paths = sorted(p for pat in PATTERNS
+                       for p in glob.glob(os.path.join(REPO, pat)))
+    bad = 0
+    for p in paths:
+        probs = validate(p)
+        rel = os.path.relpath(p, REPO)
+        if probs:
+            bad += 1
+            for msg in probs:
+                print("validate_artifact: %s: %s" % (rel, msg))
+        else:
+            print("validate_artifact: %s: ok" % rel)
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
