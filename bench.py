@@ -874,6 +874,212 @@ def _serve_bench(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --serve --mesh: mesh-sliced serving benchmark -> BENCH_MESH.json
+# ---------------------------------------------------------------------------
+
+def _mesh_run_requests(submit, xs, refs, atol=1e-5):
+    """Submit every batch, drain in order, measure client-side.
+
+    Returns wall-clock throughput plus per-request latency percentiles
+    and the agreement fraction vs the reference outputs.  GSPMD
+    guarantees the numerics up to fp reduction reorder: f32 rows agree
+    at atol=1e-5; int8 rows get 1e-4 — split-K psum reorder over
+    dequantized weights wobbles a few e-5 absolute at width 1024,
+    still ~100x below the int8 quantization error itself (~1e-2 vs
+    f32).  max_abs_diff is recorded so the tolerance is auditable."""
+    import numpy as np
+    t_submit, futs = [], []
+    t0 = time.perf_counter()
+    for x in xs:
+        t_submit.append(time.perf_counter())
+        futs.append(submit(x))
+    lat, outs = [], []
+    for ts, f in zip(t_submit, futs):
+        y = f.result(timeout=300)
+        lat.append(time.perf_counter() - ts)
+        outs.append(np.asarray(y))
+    wall = time.perf_counter() - t0
+    lat = sorted(lat)
+    agree = float(np.mean([
+        1.0 if np.allclose(o, r, atol=atol) else 0.0
+        for o, r in zip(outs, refs)]))
+    max_diff = max(float(np.max(np.abs(o - np.asarray(r))))
+                   for o, r in zip(outs, refs))
+    n_ex = sum(x.shape[0] for x in xs)
+    return {
+        "requests": len(xs),
+        "wall_s": round(wall, 4),
+        "throughput_eps": round(n_ex / wall, 2),
+        "p50_ms": round(1000 * lat[len(lat) // 2], 3),
+        "p99_ms": round(1000 * lat[min(len(lat) - 1,
+                                       int(len(lat) * 0.99))], 3),
+        "agreement": agree,
+        "agreement_atol": atol,
+        "max_abs_diff": max_diff,
+    }, outs
+
+
+def _serve_mesh_bench(argv) -> int:
+    """--serve --mesh: the mesh-sliced serving proof -> BENCH_MESH.json.
+
+    Carves the device set into tensor-parallel replica slots and serves
+    the same workload three ways — single unplaced device (the oracle),
+    a 2-slot x TP2 ReplicaSet, and one TP4 slot — for dense AND int8
+    params, reporting throughput/latency and the agreement fraction vs
+    the oracle outputs.  On CPU the 8-virtual-device fake mesh is forced
+    via XLA_FLAGS (set before backend init); on a real backend the live
+    device set is carved as-is.  Resumable per stage under the
+    measurement-artifact contract (utils/artifacts.py)."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --serve --mesh")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--requests", type=int, default=int(
+        os.environ.get("BIGDL_TPU_MESH_REQUESTS", "48")))
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--devices", type=int, default=8,
+                    help="fake-mesh width forced on the CPU host platform")
+    args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_MESH.json")
+
+    # the host-platform device count is read at backend init: set it
+    # before the first jax.devices() call or the CPU mesh stays width 1
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{args.devices}").strip()
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import jax
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.resilience import ReplicaSet
+    from bigdl_tpu.serving import ServingEngine
+    from bigdl_tpu.serving.placement import DeviceTopology, PlacementPolicy
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    n_dev = len(jax.devices())
+    feat, hidden, classes = 256, 1024, 10
+    config = {"model": f"mlp_{feat}x{hidden}x{hidden}x{classes}",
+              "batch": args.batch, "requests": args.requests,
+              "n_devices": n_dev, "dtype": "float32"}
+
+    if n_dev < 4:
+        artifacts.write_artifact(args.json, {
+            "bench": "serving_mesh_sliced", "platform": platform,
+            "config": config, "rows": [], "complete": False,
+            "error": f"needs >= 4 devices for TP slots, got {n_dev}"})
+        print(f"bench --serve --mesh: needs >= 4 devices, got {n_dev}",
+              file=sys.stderr)
+        return 1
+
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "serving_mesh_sliced", "platform": platform,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+
+    def mk(quant):
+        m = nn.Sequential(nn.Linear(feat, hidden), nn.ReLU(),
+                          nn.Linear(hidden, hidden), nn.ReLU(),
+                          nn.Linear(hidden, classes)).build(seed=7)
+        return m.quantize() if quant == "int8" else m
+
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(args.batch, feat).astype(np.float32)
+          for _ in range(args.requests)]
+    eng_kw = dict(input_shape=(feat,), buckets=(args.batch,),
+                  max_batch_size=args.batch, max_wait_ms=1.0,
+                  max_queue=max(args.requests, 256))
+
+    for quant in ("f32", "int8"):
+        atol = 1e-5 if quant == "f32" else 1e-4
+        # the oracle's outputs anchor every agreement number, so they
+        # are recomputed each run even when its latency row is reused
+        with ServingEngine(mk(quant), name=f"oracle_{quant}",
+                           **eng_kw) as oracle:
+            oracle.warmup()
+            refs = [oracle._run_batch(x) for x in xs]
+            name = f"single_device_{quant}"
+            if name in prev:
+                rows.append({**prev[name], "reused_from_previous_run": True})
+            else:
+                row, _ = _mesh_run_requests(oracle.submit, xs, refs,
+                                            atol=atol)
+                rows.append({"stage": name, "quant": quant,
+                             "placement": None, **row})
+            flush()
+
+        name = f"slots2_tp2_{quant}"
+        if name in prev:
+            rows.append({**prev[name], "reused_from_previous_run": True})
+            flush()
+        else:
+            pol = PlacementPolicy(DeviceTopology.detect(), slots=2, tp=2)
+            rs = ReplicaSet(mk(quant), n_replicas=2, placement=pol,
+                            **eng_kw)
+            try:
+                rs.warmup()
+                row, _ = _mesh_run_requests(rs.submit, xs, refs,
+                                            atol=atol)
+                rows.append({"stage": name, "quant": quant,
+                             "placement": pol.stats(), **row})
+                flush()
+            finally:
+                rs.close()
+
+        name = f"slots1_tp4_{quant}"
+        if name in prev:
+            rows.append({**prev[name], "reused_from_previous_run": True})
+            flush()
+        else:
+            pol = PlacementPolicy(DeviceTopology.detect(), slots=1, tp=4)
+            with ServingEngine(mk(quant), name=f"tp4_{quant}",
+                               placement=pol.acquire(), **eng_kw) as eng:
+                eng.warmup()
+                row, _ = _mesh_run_requests(eng.submit, xs, refs,
+                                            atol=atol)
+                rows.append({"stage": name, "quant": quant,
+                             "placement": pol.stats(), **row})
+                flush()
+
+    by_stage = {r["stage"]: r for r in rows}
+    result["summary"] = {
+        "agreement_min": min(r["agreement"] for r in rows),
+        "single_throughput_eps": by_stage["single_device_f32"]
+        ["throughput_eps"],
+        "slots2_tp2_throughput_eps": by_stage["slots2_tp2_f32"]
+        ["throughput_eps"],
+        "slots1_tp4_throughput_eps": by_stage["slots1_tp4_f32"]
+        ["throughput_eps"],
+    }
+    result["complete"] = True
+    flush()
+    print(json.dumps({
+        "metric": "mesh_sliced_serving_agreement",
+        "value": result["summary"]["agreement_min"],
+        "unit": "fraction", "platform": platform,
+        **result["summary"]}), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --serve-lm: continuous-batching LM serving benchmark -> BENCH_LM_SERVE.json
 # ---------------------------------------------------------------------------
 
@@ -1768,6 +1974,9 @@ if __name__ == "__main__":
     if "--serve-lm" in sys.argv:
         sys.exit(_serve_lm_bench(
             [a for a in sys.argv[1:] if a != "--serve-lm"]))
+    if "--serve" in sys.argv and "--mesh" in sys.argv:
+        sys.exit(_serve_mesh_bench(
+            [a for a in sys.argv[1:] if a not in ("--serve", "--mesh")]))
     if "--serve" in sys.argv:
         sys.exit(_serve_bench([a for a in sys.argv[1:] if a != "--serve"]))
     elif os.environ.get("BIGDL_TPU_BENCH_INNER"):

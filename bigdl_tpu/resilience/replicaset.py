@@ -40,9 +40,9 @@ DRAINING = "draining"
 
 class _Replica:
     __slots__ = ("name", "engine", "state", "inflight", "dispatched",
-                 "failures", "consecutive_failures", "opened_at")
+                 "failures", "consecutive_failures", "opened_at", "slot")
 
-    def __init__(self, name: str, engine):
+    def __init__(self, name: str, engine, slot=None):
         self.name = name
         self.engine = engine
         self.state = HEALTHY
@@ -51,6 +51,7 @@ class _Replica:
         self.failures = 0
         self.consecutive_failures = 0
         self.opened_at = 0.0
+        self.slot = slot  # MeshSlice under placement, else None
 
 
 class ReplicaSet:
@@ -76,6 +77,14 @@ class ReplicaSet:
             after a failure before the set gives up (default: try every
             replica once).
         clock: injectable monotonic clock (tests drive breaker timing).
+        placement: optional
+            :class:`~bigdl_tpu.serving.placement.PlacementPolicy` — one
+            replica = one mesh slot.  Every member engine is built on
+            its own acquired :class:`MeshSlice` (params sharded
+            tensor-parallel across the slot's devices), ``scale_to``
+            acquires/releases slots, and growth past the policy's
+            headroom is refused instead of oversubscribing devices
+            (see :meth:`try_scale_up`).
         Remaining kwargs mirror :class:`ServingEngine` / DynamicBatcher
         policy knobs.
     """
@@ -93,6 +102,7 @@ class ReplicaSet:
                  dtype="float32",
                  platform: Optional[str] = None,
                  use_shared_pool: bool = True,
+                 placement=None,
                  **engine_kwargs):
         modules = (list(module) if isinstance(module, (list, tuple))
                    else None)
@@ -128,14 +138,18 @@ class ReplicaSet:
         self._engine_cfg = dict(input_shape=input_shape, buckets=buckets,
                                 max_batch_size=max_batch_size, dtype=dtype,
                                 platform=platform, **engine_kwargs)
+        self.placement = placement
         self._next_idx = n_replicas
         self._replicas = []
         for i in range(n_replicas):
             name = f"r{i}"
+            slot = self._acquire_slot(required=True) \
+                if placement is not None else None
             engine = ServingEngine(
                 modules[i] if modules is not None else module,
-                name=name, with_batcher=False, **self._engine_cfg)
-            self._replicas.append(_Replica(name, engine))
+                name=name, with_batcher=False,
+                **self._with_slot(slot))
+            self._replicas.append(_Replica(name, engine, slot=slot))
         ref = self._replicas[0].engine
         # one batching policy for the whole set, published as the
         # process's serving/* metrics (created after the member engines
@@ -156,6 +170,25 @@ class ReplicaSet:
     def _publish_replica_count(self) -> None:
         n = sum(1 for r in self._replicas if r.state != DRAINING)
         self._registry.gauge("resilience/replicas").set(n)
+
+    def _acquire_slot(self, *, required: bool):
+        """One mesh slot from the placement policy; raises (required)
+        or returns None (opportunistic growth) when the devices are
+        fully packed."""
+        slot = self.placement.acquire()
+        if slot is None and required:
+            from bigdl_tpu.serving.placement import PlacementError
+            raise PlacementError(
+                f"placement policy exhausted: {self.placement.slots_total} "
+                "slot(s) total, none free — fewer replicas or a smaller "
+                "TP degree")
+        return slot
+
+    def _with_slot(self, slot) -> dict:
+        cfg = dict(self._engine_cfg)
+        if slot is not None:
+            cfg["placement"] = slot
+        return cfg
 
     # ---------------------------------------------------------------- #
     # health / breaker state machine (all transitions under _lock)     #
@@ -293,18 +326,30 @@ class ReplicaSet:
             live = [r for r in self._replicas if r.state != DRAINING]
         if n > len(live):
             warm_shape = live[0].engine.input_shape if live else None
+            added = 0
             for _ in range(n - len(live)):
+                slot = None
+                if self.placement is not None:
+                    slot = self._acquire_slot(required=False)
+                    if slot is None:
+                        # full device set: grow as far as the slots go
+                        # rather than stacking replicas on shared chips
+                        log.warning(
+                            "scale_to(%d): placement headroom exhausted "
+                            "after +%d replica(s)", n, added)
+                        break
                 name = f"r{self._next_idx}"
                 self._next_idx += 1
                 engine = self._engine_cls(
                     self._scale_module, name=name, with_batcher=False,
-                    **self._engine_cfg)
+                    **self._with_slot(slot))
                 if warm_shape is not None:
                     engine.warmup(warm_shape)
                 with self._lock:
-                    self._replicas.append(_Replica(name, engine))
+                    self._replicas.append(_Replica(name, engine, slot=slot))
+                added += 1
                 log.info("replica %s: added by scale_to(%d)", name, n)
-            self._registry.counter("resilience/scale_ups").add(n - len(live))
+            self._registry.counter("resilience/scale_ups").add(added)
         elif n < len(live):
             victims = live[n:]  # newest first out: r0 keeps seniority
             with self._lock:
@@ -315,6 +360,9 @@ class ReplicaSet:
                 while r.inflight > 0 and time.monotonic() < deadline:
                     time.sleep(0.005)
                 r.engine.close()
+                if r.slot is not None:
+                    self.placement.release(r.slot)
+                    r.slot = None
                 log.info("replica %s: drained and closed by scale_to(%d)",
                          r.name, n)
             with self._lock:
@@ -326,6 +374,20 @@ class ReplicaSet:
         self._publish_replica_count()
         with self._lock:
             return sum(1 for r in self._replicas if r.state != DRAINING)
+
+    def try_scale_up(self, max_replicas: Optional[int] = None) -> bool:
+        """The SLO controller's device-aware scale_up hook: add ONE
+        replica if the placement policy has a free slot (always, when
+        unplaced and under ``max_replicas``); returns whether capacity
+        was actually added — False makes the controller's ladder fall
+        through to admission tightening instead of oversubscribing."""
+        with self._lock:
+            live = sum(1 for r in self._replicas if r.state != DRAINING)
+        if max_replicas is not None and live >= int(max_replicas):
+            return False
+        if self.placement is not None and self.placement.headroom() < 1:
+            return False
+        return self.scale_to(live + 1) > live
 
     def submit(self, x, *, batched: bool = True) -> Future:
         if self._closed:
@@ -347,12 +409,16 @@ class ReplicaSet:
                 r.name: {"state": r.state, "inflight": r.inflight,
                          "dispatched": r.dispatched,
                          "failures": r.failures,
-                         "consecutive_failures": r.consecutive_failures}
+                         "consecutive_failures": r.consecutive_failures,
+                         "placement": (r.slot.describe()
+                                       if r.slot is not None else None)}
                 for r in self._replicas}
         return {
             "replicas": replicas,
             "pending": self.batcher.pending(),
             "buckets": list(self.batcher.buckets),
+            "placement": (self.placement.stats()
+                          if self.placement is not None else None),
             "metrics": self.metrics.snapshot(
                 self._replicas[0].engine.cache.stats()),
         }
@@ -367,6 +433,9 @@ class ReplicaSet:
                 r.state = DRAINING
         for r in self._replicas:
             r.engine.close()
+            if r.slot is not None:
+                self.placement.release(r.slot)
+                r.slot = None
         self._publish_open_circuits()
 
     def __enter__(self) -> "ReplicaSet":

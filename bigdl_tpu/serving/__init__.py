@@ -31,6 +31,10 @@ from bigdl_tpu.serving.kvcache import (BlockPool, PoolExhausted, RadixCache,
 from bigdl_tpu.serving.lm_engine import (LMMetrics, LMServingEngine,
                                          LMStream, prefill_bucket_lengths)
 from bigdl_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from bigdl_tpu.serving.placement import (DeviceTopology, MeshSlice,
+                                         MeshSlicer, PlacementError,
+                                         PlacementPolicy, serving_tp_rules,
+                                         shard_params_chunked)
 
 __all__ = [
     "ServingEngine", "DynamicBatcher", "CompileCache", "HostStager",
@@ -38,4 +42,6 @@ __all__ = [
     "ServingOverloaded", "ServingClosed", "power_of_two_buckets",
     "LMServingEngine", "LMStream", "LMMetrics", "prefill_bucket_lengths",
     "BlockPool", "RadixCache", "PoolExhausted", "RequestExceedsPool",
+    "DeviceTopology", "MeshSlice", "MeshSlicer", "PlacementError",
+    "PlacementPolicy", "serving_tp_rules", "shard_params_chunked",
 ]

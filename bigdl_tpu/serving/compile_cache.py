@@ -6,11 +6,16 @@ could neither observe hit rates nor bound entries nor pre-warm.  This
 cache is the explicit version: entries are ahead-of-time compiled
 executables (``jit(fn).lower(...).compile()``) keyed on
 
-    (bucket input shape, input dtype, donate flags, params quant dtype)
+    (bucket input shape, input dtype, donate flags, params quant dtype,
+     placement tag)
 
-— the last component is what lets one cache hold f32 and int8 replicas
-of the same model simultaneously (quant.params_dtype_tag: "int8" when
-the params tree carries QTensor leaves, "bf16"/"f32" otherwise), with
+— the quant-dtype component is what lets one cache hold f32 and int8
+replicas of the same model simultaneously (quant.params_dtype_tag:
+"int8" when the params tree carries QTensor leaves, "bf16"/"f32"
+otherwise), and the placement tag (``MeshSlice.tag``, "" unplaced)
+keeps executables compiled for different device slots apart — an AOT
+executable bakes in its committed-input devices, so a slot0 entry
+replayed for slot1 params would be a silent cross-slot transfer.  With
 hit/miss/evict counters and a warmup API that pre-traces the
 configured buckets before traffic arrives.  The batcher pads every
 batch to a configured bucket, so steady state is all hits and the
@@ -27,7 +32,7 @@ import threading
 from collections import OrderedDict
 from typing import Callable, Sequence, Tuple
 
-Key = Tuple[tuple, tuple, str]
+Key = Tuple[tuple, tuple, str, str]
 
 
 def input_signature(x) -> tuple:
@@ -59,10 +64,11 @@ class CompileCache:
     """
 
     def __init__(self, fn: Callable, *, max_entries: int = 16,
-                 donate_x: bool = False):
+                 donate_x: bool = False, placement_tag: str = ""):
         import jax
 
         self._donate = ("x",) if donate_x else ()
+        self._placement_tag = placement_tag
         # donating x lets XLA reuse the input buffer for activations;
         # params/buffers are never donated (reused every call)
         self._jit = jax.jit(fn, donate_argnums=(2,) if donate_x else ())
@@ -77,7 +83,8 @@ class CompileCache:
     def key_for(self, x, params=None) -> Key:
         from bigdl_tpu.quant import params_dtype_tag
         return (input_signature(x), self._donate,
-                params_dtype_tag(params) if params is not None else "f32")
+                params_dtype_tag(params) if params is not None else "f32",
+                self._placement_tag)
 
     def _compile(self, params, buffers, x) -> Callable:
         return self._jit.lower(params, buffers, x).compile()

@@ -66,6 +66,17 @@ class ServingEngine:
             DynamicBatcher — submit/predict are disabled and batches
             arrive through ``_run_batch`` from an external dispatcher
             (the ReplicaSet mode: one queue fronting N engines).
+        placement: optional
+            :class:`~bigdl_tpu.serving.placement.MeshSlice` — the
+            engine's device slot.  Params land sharded across the
+            slot's devices (tensor-parallel over its ``model`` axis),
+            staged inputs land replicated on the slot, and compiled
+            entries are keyed by the slot tag.  None keeps the classic
+            single-device behavior bit-for-bit.
+        tp_rules: optional ``rules(path, leaf) -> NamedSharding|None``
+            overriding the derived
+            :func:`~bigdl_tpu.serving.placement.serving_tp_rules` for
+            custom module trees.
     """
 
     def __init__(self, module, *,
@@ -81,7 +92,9 @@ class ServingEngine:
                  chunk_bytes: int = DEFAULT_CHUNK_BYTES,
                  use_shared_pool: bool = True,
                  name: str = "engine",
-                 with_batcher: bool = True):
+                 with_batcher: bool = True,
+                 placement=None,
+                 tp_rules=None):
         select_platform(platform)
         import jax
         import jax.numpy as jnp
@@ -89,6 +102,7 @@ class ServingEngine:
         module._built()
         self.module = module
         self.name = name
+        self.placement = placement
         # freeze: the engine holds its own references; later training
         # steps rebind module.params and never touch these
         self._params = module.params
@@ -100,10 +114,29 @@ class ServingEngine:
         # payload through the shared 32 MB chunked-transfer discipline
         # (~4x fewer bytes through the tunneled relay than f32) and
         # publish the wire win as quant/* gauges
-        from bigdl_tpu.quant import params_dtype_tag, stage_quantized_params
+        from bigdl_tpu.quant import (params_dtype_tag, params_nbytes,
+                                     stage_quantized_params)
         self.quant_dtype = params_dtype_tag(self._params)
         self._quant_bytes_staged = 0
-        if self.quant_dtype == "int8":
+        if placement is not None:
+            # one chunked pass straight to the sharded layout — staging
+            # dense-on-one-device first and resharding would push the
+            # payload through the tunnel twice
+            from bigdl_tpu.serving.placement import (serving_tp_rules,
+                                                     shard_params_chunked)
+            if tp_rules is None and placement.tp > 1:
+                tp_rules = serving_tp_rules(module, placement.mesh)
+            rules = tp_rules if tp_rules is not None else (lambda p, l: None)
+            self._params = shard_params_chunked(
+                self._params, rules, placement.mesh, chunk_bytes=chunk_bytes)
+            rep = placement.replicated()
+            self._buffers = jax.tree_util.tree_map(
+                lambda b: jax.device_put(b, rep), self._buffers)
+            if self.quant_dtype == "int8":
+                self._quant_bytes_staged = params_nbytes(self._params)
+                get_registry().gauge("quant/serving_bytes_staged", unit="B") \
+                    .set(self._quant_bytes_staged)
+        elif self.quant_dtype == "int8":
             self._params, self._quant_bytes_staged = stage_quantized_params(
                 self._params, chunk_bytes=chunk_bytes)
             get_registry().gauge("quant/serving_bytes_staged", unit="B") \
@@ -126,6 +159,10 @@ class ServingEngine:
         _rng = jax.random.PRNGKey(0)  # inert: training=False paths
         _module = module
 
+        _out_sharding = (placement.replicated()
+                         if placement is not None and placement.tp > 1
+                         else None)
+
         def _infer(params, buffers, x):
             # inside the trace: expand non-native QTensors (identity
             # for f32 replicas); native ones dequant in their kernels
@@ -133,11 +170,20 @@ class ServingEngine:
             y, _ = _module.apply(dequantize_entry(params), x,
                                  buffers=buffers,
                                  training=False, rng=_rng)
+            if _out_sharding is not None:
+                # a col-parallel tail would leave the output sharded on
+                # its last dim; pin it replicated so the host pull is
+                # one clean gather instead of per-shard fetches
+                y = jax.lax.with_sharding_constraint(y, _out_sharding)
             return y
 
-        self.cache = CompileCache(_infer, max_entries=max_cache_entries,
-                                  donate_x=donate_x)
-        self.stager = HostStager(self._dtype, chunk_bytes=chunk_bytes)
+        self.cache = CompileCache(
+            _infer, max_entries=max_cache_entries, donate_x=donate_x,
+            placement_tag=placement.tag if placement is not None else "")
+        self.stager = HostStager(
+            self._dtype, chunk_bytes=chunk_bytes,
+            device=placement.input_sharding() if placement is not None
+            else None)
         # live metrics, published into the process-wide obs registry
         # (latest engine owns the serving/* names)
         self.metrics = ServingMetrics().publish_to(get_registry())
@@ -222,6 +268,15 @@ class ServingEngine:
                              "and no request seen yet)")
         self.input_shape = shape
         shapes = [(b,) + shape for b in self.buckets]
+        if self.placement is not None:
+            # AOT executables bake in committed-input shardings: warmup
+            # inputs must arrive exactly like traffic does — through the
+            # stager onto the slot — or the compiled entries would
+            # expect default-device inputs and recompile on first hit
+            inputs = [self.stager.stage(np.zeros(s, self._dtype))
+                      for s in shapes]
+            return self.cache.warmup_inputs(self._params, self._buffers,
+                                            inputs)
         return self.cache.warmup(self._params, self._buffers, shapes,
                                  self._dtype)
 
@@ -259,6 +314,8 @@ class ServingEngine:
             "buckets": list(self.buckets),
             "quant_dtype": self.quant_dtype,
             "quant_bytes_staged": self._quant_bytes_staged,
+            "placement": (self.placement.describe()
+                          if self.placement is not None else None),
             "compile_cache": self.cache.stats(),
             "host_transfer": self.stager.stats(),
             "metrics": self.metrics.snapshot(self.cache.stats()),

@@ -377,7 +377,9 @@ class LMServingEngine:
                  platform: Optional[str] = None,
                  donate_cache: bool = True,
                  decode_attn: str = "auto",
-                 name: str = "lm"):
+                 name: str = "lm",
+                 placement=None,
+                 tp_rules=None):
         select_platform(platform)
         import jax
         from bigdl_tpu.models.transformer.generate import (
@@ -388,8 +390,25 @@ class LMServingEngine:
         model._built()
         self.model = model
         self.name = name
+        self.placement = placement
         self._params = model.params
         self._buffers = model.buffers
+        if placement is not None:
+            # TP across the slot: Megatron layer-stacked rules; flash
+            # attention does not partition under GSPMD, pin XLA first
+            from bigdl_tpu.parallel.tensor_parallel import (
+                pin_xla_attention, transformer_lm_tp_rules)
+            from bigdl_tpu.serving.placement import shard_params_chunked
+            if placement.tp > 1:
+                pin_xla_attention(model)
+                if tp_rules is None:
+                    tp_rules = transformer_lm_tp_rules(placement.mesh)
+            rules = tp_rules if tp_rules is not None else (lambda p, l: None)
+            self._params = shard_params_chunked(self._params, rules,
+                                                placement.mesh)
+            rep = placement.replicated()
+            self._buffers = jax.tree_util.tree_map(
+                lambda b: jax.device_put(b, rep), self._buffers)
         self.slots = int(slots)
         self.cache_len = int(cache_len or model.max_len)
         if self.cache_len > model.max_len:
@@ -426,6 +445,14 @@ class LMServingEngine:
         self.pool = BlockPool(n_layers=L, n_heads=H, head_dim=D,
                               block_len=self.block_len,
                               num_blocks=num_blocks, dtype=dt)
+        if placement is not None:
+            # KV arenas live replicated on the slot: every TP device
+            # attends over the full (sharded-head math happens on the
+            # projections, not the cache) and the donated insert/decode
+            # executables keep the committed layout
+            _rep = placement.replicated()
+            self.pool.k = jax.device_put(self.pool.k, _rep)
+            self.pool.v = jax.device_put(self.pool.v, _rep)
         self.radix = RadixCache(self.pool) if enable_prefix_cache else None
         self._cache_dtype = dt
         # prefix-chain pad buckets (powers of two up to the table width)
@@ -433,22 +460,35 @@ class LMServingEngine:
             self.table_width, min_bucket=1)
 
         # -- the device programs ---------------------------------------- #
+        _ptag = placement.tag if placement is not None else ""
+        _out_rep = (placement.replicated()
+                    if placement is not None and placement.tp > 1 else None)
+
+        def _constrain(y):
+            # TP leaves prefill logits/kv sharded mid-graph; pin every
+            # output replicated so the host pull and the (replicated)
+            # arena insert see one clean layout
+            if _out_rep is None:
+                return y
+            return jax.lax.with_sharding_constraint(y, _out_rep)
+
         def _prefill_fn(params, buffers, x):
             del buffers  # part of the CompileCache signature only
-            return _prefill_parts(model, dequantize_entry(params),
-                                  x["ids"], x["len"] - 1)
+            return _constrain(_prefill_parts(model, dequantize_entry(params),
+                                             x["ids"], x["len"] - 1))
 
         self.prefill_cache = CompileCache(
-            _prefill_fn, max_entries=max_cache_entries)
+            _prefill_fn, max_entries=max_cache_entries, placement_tag=_ptag)
 
         def _prefix_prefill_fn(params, buffers, x):
             del buffers
-            return _prefill_suffix_parts(
+            return _constrain(_prefill_suffix_parts(
                 model, dequantize_entry(params), x["ids"], x["len"] - 1,
-                x["prefix_len"], x["blocks"], x["k"], x["v"])
+                x["prefix_len"], x["blocks"], x["k"], x["v"]))
 
         self.prefix_prefill_cache = CompileCache(
-            _prefix_prefill_fn, max_entries=max_cache_entries)
+            _prefix_prefill_fn, max_entries=max_cache_entries,
+            placement_tag=_ptag)
 
         if decode_attn not in ("auto", "gather", "paged_kernel"):
             raise ValueError(f"decode_attn must be 'auto', 'gather' or "
@@ -465,9 +505,9 @@ class LMServingEngine:
         self.decode_attn = decode_attn
 
         def _decode_fn(params, token, pos, tables, kc, vc):
-            return _decode_step_paged(model, dequantize_entry(params),
-                                      token, pos, tables, kc, vc,
-                                      attn_impl=decode_attn)
+            return _constrain(_decode_step_paged(
+                model, dequantize_entry(params), token, pos, tables, kc, vc,
+                attn_impl=decode_attn))
 
         donate = (4, 5) if donate_cache else ()
         self._decode_jit = jax.jit(_decode_fn, donate_argnums=donate)
@@ -573,9 +613,17 @@ class LMServingEngine:
 
     def _decode_compiled(self):
         if self._decode_exec is None:
-            tok = np.zeros((self.slots,), np.int32)
-            pos = np.zeros((self.slots,), np.int32)
-            tables = np.zeros((self.slots, self.table_width), np.int32)
+            import jax
+            # under placement the scheduler's np operands must lower as
+            # slot-replicated (an unannotated lowering would bake in the
+            # default device, clashing with the slot-committed params);
+            # Compiled.__call__ auto-places the uncommitted np arrays
+            sh = (dict(sharding=self.placement.replicated())
+                  if self.placement is not None else {})
+            sds = jax.ShapeDtypeStruct
+            tok = sds((self.slots,), np.int32, **sh)
+            pos = sds((self.slots,), np.int32, **sh)
+            tables = sds((self.slots, self.table_width), np.int32, **sh)
             self._decode_exec = self._decode_jit.lower(
                 self._params, tok, pos, tables,
                 self.pool.k, self.pool.v).compile()
@@ -588,11 +636,13 @@ class LMServingEngine:
             L, N, H, B, D = self.pool.shape
             nb = -(-bucket // B)
             sds = jax.ShapeDtypeStruct
-            new = sds((L, 1, H, bucket, D), self._cache_dtype)
+            sh = (dict(sharding=self.placement.replicated())
+                  if self.placement is not None else {})
+            new = sds((L, 1, H, bucket, D), self._cache_dtype, **sh)
             exe = self._insert_jit.lower(
-                sds(self.pool.shape, self._cache_dtype),
-                sds(self.pool.shape, self._cache_dtype),
-                new, new, sds((nb,), np.int32)).compile()
+                sds(self.pool.shape, self._cache_dtype, **sh),
+                sds(self.pool.shape, self._cache_dtype, **sh),
+                new, new, sds((nb,), np.int32, **sh)).compile()
             self._insert_execs[bucket] = exe
         return exe
 
@@ -989,6 +1039,8 @@ class LMServingEngine:
             "cache_len": self.cache_len,
             "block_len": self.block_len,
             "decode_attn": self.decode_attn,
+            "placement": (self.placement.describe()
+                          if self.placement is not None else None),
             "prefill_buckets": list(self.prefill_buckets),
             "prefill_cache": self.prefill_cache.stats(),
             "prefix_prefill_cache": self.prefix_prefill_cache.stats(),

@@ -1,0 +1,95 @@
+"""PlacementPolicy: pack replicas onto mesh slots, report headroom.
+
+The ReplicaSet asks the policy for a slot per replica (acquire) and
+hands slots back when replicas drain (release); `headroom()` is the
+scale-up gate the SLO controller consults before growing — the same
+contract as PR 7's `kvcache_headroom`: a falsy answer makes the ladder
+fall through to admission tightening instead of oversubscribing
+devices.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from bigdl_tpu.serving.placement.slicer import (MeshSlice, MeshSlicer,
+                                                PlacementError)
+from bigdl_tpu.serving.placement.topology import DeviceTopology
+
+
+class PlacementPolicy:
+    """Carve once, then hand out slots first-fit.
+
+    Args:
+        topology: device set to carve (default: detect the live backend).
+        slots: number of replica slots; default ``max_slots(tp)`` — use
+            everything the backend has.
+        tp: tensor-parallel degree within each slot.
+    """
+
+    def __init__(self, topology: Optional[DeviceTopology] = None, *,
+                 slots: Optional[int] = None, tp: int = 1):
+        slicer = MeshSlicer(topology)
+        if slots is None:
+            slots = max(1, slicer.max_slots(tp))
+        self.tp = int(tp)
+        self._slices: List[MeshSlice] = slicer.carve(slots, tp)
+        self._free: List[MeshSlice] = list(self._slices)
+        self._lock = threading.Lock()
+        self._publish()
+
+    # -- slot lifecycle -------------------------------------------------
+
+    def acquire(self) -> Optional[MeshSlice]:
+        """Lowest-id free slot, or None when the device set is full."""
+        with self._lock:
+            if not self._free:
+                return None
+            s = self._free.pop(0)
+        self._publish()
+        return s
+
+    def release(self, s: MeshSlice) -> None:
+        with self._lock:
+            if s not in self._slices:
+                raise PlacementError(f"{s!r} was not carved by this policy")
+            if s in self._free:
+                raise PlacementError(f"{s!r} released twice")
+            self._free.append(s)
+            self._free.sort(key=lambda m: m.slot_id)
+        self._publish()
+
+    # -- accounting -----------------------------------------------------
+
+    @property
+    def slots_total(self) -> int:
+        return len(self._slices)
+
+    def headroom(self) -> int:
+        """Free slots — 0 means scale-up must be refused."""
+        with self._lock:
+            return len(self._free)
+
+    def stats(self) -> dict:
+        with self._lock:
+            free = len(self._free)
+        return {
+            "slots_total": self.slots_total,
+            "slots_used": self.slots_total - free,
+            "slots_free": free,
+            "devices_per_slot": self.tp,
+            "slots": [s.describe() for s in self._slices],
+        }
+
+    def _publish(self) -> None:
+        from bigdl_tpu.obs import get_registry
+        reg = get_registry()
+        with self._lock:
+            free = len(self._free)
+        reg.gauge("serving/placement/slots_total").set(self.slots_total)
+        reg.gauge("serving/placement/slots_used").set(self.slots_total - free)
+        reg.gauge("serving/placement/devices_per_slot").set(self.tp)
+
+    def __repr__(self) -> str:
+        return (f"PlacementPolicy({self.slots_total} slots x TP{self.tp}, "
+                f"{self.headroom()} free)")

@@ -1,0 +1,101 @@
+"""MeshSlicer: carve a device set into named submeshes (replica slots).
+
+A *slot* is the serving unit of placement: a contiguous group of ``tp``
+devices carrying one engine replica, tensor-parallel within the slot.
+Data parallelism across slots is NOT a mesh axis here — it is the
+ReplicaSet's least-loaded dispatch, so a dead slot is a replica-death
+event the resilience layer already handles, not a collective hang.
+Each slot therefore gets its own 1-axis ``model`` mesh rather than one
+global 2-D mesh.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from bigdl_tpu.parallel.mesh import MODEL_AXIS, create_mesh, replicated
+from bigdl_tpu.serving.placement.topology import DeviceTopology
+
+
+class PlacementError(RuntimeError):
+    """A carve or acquire that the device set cannot satisfy."""
+
+
+class MeshSlice:
+    """One replica slot: ``tp`` devices under a 1-D ``model``-axis mesh.
+
+    The slice IS the engine's placement parameter — it owns the mesh and
+    derives every sharding the engine needs from it.
+    """
+
+    __slots__ = ("slot_id", "devices", "tp", "mesh")
+
+    def __init__(self, slot_id: int, devices, tp: int):
+        if len(devices) != tp:
+            raise PlacementError(
+                f"slot {slot_id}: {len(devices)} devices != tp={tp}")
+        self.slot_id = int(slot_id)
+        self.devices = tuple(devices)
+        self.tp = int(tp)
+        self.mesh = create_mesh({MODEL_AXIS: tp}, devices=list(devices))
+
+    @property
+    def tag(self) -> str:
+        """Stable string for compile-cache keys and stats: the same
+        bucket compiled for a different slot (different devices) must
+        not collide in a shared CompileCache."""
+        return f"slot{self.slot_id}:tp{self.tp}:d{','.join(str(i) for i in self.device_ids)}"
+
+    @property
+    def device_ids(self) -> tuple:
+        return tuple(int(d.id) for d in self.devices)
+
+    def replicated(self):
+        """NamedSharding replicating a value across the slot's devices."""
+        return replicated(self.mesh)
+
+    def input_sharding(self):
+        """Where staged request payloads land: replicated across the
+        slot (TP shards weights, not the batch — every device sees the
+        full batch and XLA psums the row-parallel outputs)."""
+        return replicated(self.mesh)
+
+    def describe(self) -> dict:
+        return {"slot_id": self.slot_id, "tp": self.tp,
+                "device_ids": list(self.device_ids)}
+
+    def __repr__(self) -> str:
+        return f"MeshSlice({self.tag})"
+
+
+class MeshSlicer:
+    """Carve a :class:`DeviceTopology` into equal-width replica slots."""
+
+    def __init__(self, topology: Optional[DeviceTopology] = None):
+        self.topology = topology or DeviceTopology.detect()
+
+    def max_slots(self, tp: int = 1) -> int:
+        """How many tp-wide slots the device set can hold."""
+        if tp < 1:
+            raise PlacementError(f"tp must be >= 1, got {tp}")
+        return self.topology.n_devices // tp
+
+    def carve(self, slots: int, tp: int = 1) -> List[MeshSlice]:
+        """``slots`` slices of ``tp`` contiguous devices each.
+
+        Contiguity matters on real hardware: jax.devices() orders TPU
+        chips by ICI coordinates, so adjacent ids share the fastest
+        links — the same reason the reference pinned one executor's
+        task slots to one physical node.
+        """
+        if slots < 1:
+            raise PlacementError(f"slots must be >= 1, got {slots}")
+        need = slots * tp
+        have = self.topology.n_devices
+        if need > have:
+            raise PlacementError(
+                f"cannot carve {slots} slot(s) x TP{tp} = {need} devices "
+                f"from a {have}-device topology"
+                f"{' (degraded detection)' if self.topology.degraded else ''}")
+        devs = self.topology.devices
+        return [MeshSlice(i, devs[i * tp:(i + 1) * tp], tp)
+                for i in range(slots)]

@@ -62,7 +62,14 @@ class SLOController:
         scale_up / scale_down: capacity actuators; ``scale_up`` returns
             truthy if it actually added capacity (falsy means exhausted
             — the controller moves to admission control).  Optional:
-            ``None`` skips straight to admission.
+            ``None`` skips straight to admission.  Capacity must be
+            REAL: wire device-aware hooks —
+            ``ReplicaSet.try_scale_up`` (refuses when the
+            :class:`~bigdl_tpu.serving.placement.PlacementPolicy` has
+            no free mesh slot) or an LM hook gated on
+            ``kvcache_headroom()`` — never a bare ``scale_to(n+1)``,
+            which would happily stack replicas onto already-busy
+            devices and convert overload into slower everything.
         admission_levels: enqueue bounds, loosest first (e.g.
             ``[64, 32, 16, 8]``).  ``set_admission(level_value)`` is
             called whenever the controller moves along the ladder.

@@ -44,11 +44,20 @@ def chunked_device_put(x_host, dtype=None, *,
     that fit in one chunk take the single device_put fast path; 0-d
     arrays always do.
 
+    ``device`` may be a ``jax.sharding.Sharding`` (e.g. a placement
+    slice's ``NamedSharding``): each chunk then lands pre-sharded —
+    dtype conversion happens host-side and ``jax.device_put`` goes
+    straight to the sharded layout, never materializing the dense
+    array on one device first.  When dim 0 is itself sharded, chunk
+    row counts are rounded to a multiple of the dim-0 shard count so
+    every slice splits evenly.
+
     A slice that fails transiently retries up to ``max_retries`` times
     with backoff, halving the working chunk size toward
     ``min_chunk_bytes`` before each retry; exhausted retries and dead
     backends raise :class:`~bigdl_tpu.resilience.errors.BackendLostError`.
     """
+    import jax
     import jax.numpy as jnp
     import numpy as np
 
@@ -57,11 +66,18 @@ def chunked_device_put(x_host, dtype=None, *,
 
     x_host = np.asarray(x_host)
     target = jnp.dtype(dtype) if dtype is not None else x_host.dtype
+    is_sharding = isinstance(device, jax.sharding.Sharding)
 
     def _put(a):
+        if is_sharding:
+            # host-side dtype conversion (ml_dtypes covers bf16), then
+            # one device_put directly onto the sharded layout — going
+            # through jnp.asarray would stage the dense array on the
+            # default device first, the detour this path exists to avoid
+            arr = np.asarray(a, target)
+            return jax.device_put(arr, device)
         arr = jnp.asarray(a, target)
         if device is not None:
-            import jax
             arr = jax.device_put(arr, device)
         return arr
 
@@ -76,9 +92,16 @@ def chunked_device_put(x_host, dtype=None, *,
     itemsize = jnp.dtype(target).itemsize
     per_row = max(1, int(x_host[0:1].size) * itemsize)
     n = x_host.shape[0]
+    # dim-0 shard count: chunks must split evenly across it
+    shard0 = 1
+    if is_sharding:
+        try:
+            shard0 = max(1, n // device.shard_shape(x_host.shape)[0])
+        except Exception:  # noqa: BLE001 — unsized/indivisible: single put
+            shard0 = n if n > 0 else 1
     # mutable so the on_transient hook below downshifts mid-transfer;
     # later slices keep the reduced size (the relay stays flaky)
-    state = {"chunk": max(int(chunk_bytes), per_row)}
+    state = {"chunk": max(int(chunk_bytes), per_row * shard0)}
     floor = max(1, min(int(min_chunk_bytes), state["chunk"]))
 
     def _downshift(attempt, exc):
@@ -94,6 +117,8 @@ def chunked_device_put(x_host, dtype=None, *,
     while i < n:
         def _stage(i=i):
             rows = max(1, state["chunk"] // per_row)
+            if shard0 > 1:
+                rows = max(shard0, rows - rows % shard0)
             piece = x_host[i:i + rows]
             with _tr.span("h2d/chunk", cat="transfer", offset_rows=i,
                           rows=int(piece.shape[0]),
@@ -115,6 +140,11 @@ def chunked_device_put(x_host, dtype=None, *,
         return parts[0]
     with _tr.span("h2d/assemble", cat="transfer", chunks=len(parts)):
         out = jnp.concatenate(parts, axis=0)
+        if is_sharding:
+            # re-commit: concatenation of sharded parts lets XLA pick
+            # the output layout; the caller was promised ``device``.
+            # Device-to-device only — no further host transfer.
+            out = jax.device_put(out, device)
         out.block_until_ready()
     del parts  # don't hold a second copy of the batch alive
     return out

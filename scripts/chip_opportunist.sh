@@ -61,6 +61,7 @@ if [ "$SMOKE" = "1" ]; then
   SERVE_LM_ARGS="--requests 6 --slots 2 --cache-len 64 --mean-gap-ms 5 --probes 1"
   PREFIX_ARGS="--requests 6 --slots 2 --cache-len 96 --shared-len 32 --mean-gap-ms 5 --probes 1"
   SLO_ARGS="--loads 4,8 --duration 1.5 --chaos-duration 2 --chaos-rps 15 --slots 2 --cache-len 64"
+  MESH_ARGS="--requests 8 --batch 4"
 else
   BENCH_FLOOR=100            # a degraded-window crawl is not a result
   BENCH_ITERS=20
@@ -75,6 +76,7 @@ else
   SERVE_LM_ARGS="--requests 48 --slots 8 --cache-len 128"
   PREFIX_ARGS="--requests 24 --slots 8 --cache-len 128 --shared-len 64"
   SLO_ARGS="--loads 4,8,16,32,64 --duration 5 --chaos-duration 8"
+  MESH_ARGS="--requests 48 --batch 8"
 fi
 
 # A stage artifact counts as done when it parses as JSON and carries
@@ -109,7 +111,7 @@ PYEOF
 # driver commits leftovers anyway.
 ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json TUNE_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
-BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json \
+BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json BENCH_MESH.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
 SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
@@ -270,6 +272,28 @@ serve_lm_stage() {
   return 1
 }
 
+# mesh rides right after serve-lm: it proves the placement subsystem
+# against the REAL device set (TP-slot carving + sharded param staging
+# through the chunked relay discipline) — on a multi-chip window the
+# agreement numbers become chip evidence instead of the repo's
+# CPU-proven fake-mesh artifact.  Same ok_lm gate (the committed CPU
+# BENCH_MESH.json must never mark the TPU stage done) and the same
+# never-gates-the-round contract; a single-chip window exits in
+# seconds with an explicit degraded marker.
+mesh_stage() {
+  ok_lm BENCH_MESH.json && return 0
+  say "stage mesh: firing (budget 600s): python -u bench.py --serve --mesh $MESH_ARGS"
+  timeout 600 python -u bench.py --serve --mesh $MESH_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm BENCH_MESH.json; then
+    say "stage mesh: DONE"
+    return 0
+  fi
+  say "stage mesh: not done (rc=$rc)"
+  record_incident mesh "$rc"
+  return 1
+}
+
 # prefix rides right after serve-lm: same decode hot path plus the
 # radix-sharing plane (suffix prefill + block-table gathers), still far
 # below the 32 MB relay ceiling, and gated the same way — the repo's
@@ -377,6 +401,7 @@ while :; do
       run_stage bench BENCH_LAST.json 420 python -u bench.py
     autotune_stage
     serve_lm_stage
+    mesh_stage
     prefix_stage
     slo_stage
     # dispatch-overhead experiment: same step, SCAN_STEPS per device

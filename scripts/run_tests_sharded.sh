@@ -34,6 +34,13 @@ if [ "${ATTN_GATE:-1}" = "1" ]; then
     tests/test_autotune_attention.py -q -m "not slow" || exit 1
 fi
 
+# Placement gate: mesh-sliced serving is agreement-critical (a wrong
+# sharding rule serves silently wrong numbers from every TP slot) and
+# the whole file runs on the fake 8-device CPU mesh in seconds.
+if [ "${PLACEMENT_GATE:-1}" = "1" ]; then
+  python -m pytest tests/test_placement.py -q -m "not slow" || exit 1
+fi
+
 files=(tests/test_*.py)
 pids=()
 for i in $(seq 0 $((N - 1))); do

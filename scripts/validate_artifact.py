@@ -35,7 +35,32 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PATTERNS = ("BENCH_*.json", "TUNE_*.json", "PROFILE_*.json")
 
 
-def _problems(doc) -> list:
+def _mesh_problems(doc) -> list:
+    """BENCH_MESH.json extras: the mesh-sliced serving proof is an
+    AGREEMENT artifact — a row without its agreement fraction (or with
+    one outside [0, 1]) is not evidence, and a complete doc must carry
+    the summary the round-end driver reads (``agreement_min``)."""
+    probs = []
+    if doc.get("error"):
+        return probs  # degraded-run marker (e.g. < 4 devices) is valid
+    for i, r in enumerate(doc.get("rows", [])):
+        if not isinstance(r, dict):
+            continue
+        if "stage" not in r:
+            probs.append("mesh row %d lacks a 'stage' key" % i)
+        a = r.get("agreement")
+        if not isinstance(a, (int, float)) or not 0.0 <= a <= 1.0:
+            probs.append("mesh row %d: 'agreement' must be a fraction "
+                         "in [0, 1], got %r" % (i, a))
+    if doc.get("complete") is True:
+        summ = doc.get("summary")
+        if not isinstance(summ, dict) or "agreement_min" not in summ:
+            probs.append("complete mesh artifact lacks "
+                         "summary.agreement_min")
+    return probs
+
+
+def _problems(doc, name: str = "") -> list:
     """Contract violations for one parsed artifact document."""
     probs = []
     if isinstance(doc, list):  # JSONL: every record self-identifies
@@ -60,6 +85,8 @@ def _problems(doc) -> list:
             probs.append("'%s' is not a list" % section)
         elif not all(isinstance(r, dict) for r in rows):
             probs.append("'%s' holds non-object entries" % section)
+        if name == "BENCH_MESH.json":
+            probs.extend(_mesh_problems(doc))
         return probs
     if "metric" not in doc:
         probs.append("no 'rows', no supervisor record, no 'metric' key "
@@ -91,7 +118,7 @@ def validate(path: str) -> list:
         if not recs:
             return ["empty file"]
         doc = recs
-    return _problems(doc)
+    return _problems(doc, os.path.basename(path))
 
 
 def main(argv=None) -> int:

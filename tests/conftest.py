@@ -61,6 +61,20 @@ def _reset_engine():
     yield
 
 
+@pytest.fixture(scope="session")
+def fake_mesh():
+    """The 8-virtual-device CPU mesh this conftest forces via XLA_FLAGS
+    — the shared fixture for every multi-chip test (placement, tensor
+    parallel, grad accum).  Returns the device tuple; skips (instead of
+    silently passing on one device) when the flag did not take, e.g.
+    when a backend was initialized before conftest ran."""
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip(f"needs the 8-device CPU mesh, got {len(devs)} "
+                    "device(s) (XLA_FLAGS applied too late?)")
+    return tuple(devs[:8])
+
+
 @pytest.fixture
 def rng():
     return jax.random.PRNGKey(42)

@@ -107,11 +107,9 @@ def test_lbfgs_refuses_accumulation():
 
 
 @pytest.mark.slow
-def test_distri_indivisible_shard_names_the_axis():
+def test_distri_indivisible_shard_names_the_axis(fake_mesh):
     """Under DistriOptimizer the constraint is on the PER-DEVICE shard;
     the error must say so (global batch 16 / 8 devices = 2, accum 4)."""
-    if jax.device_count() < 8:
-        pytest.skip("needs the 8-device CPU mesh")
     from bigdl_tpu.parallel import DistriOptimizer
     model = nn.Sequential(nn.Linear(FEAT, 3), nn.LogSoftMax()).build(seed=1)
     opt = DistriOptimizer(model, _dataset(16), nn.ClassNLLCriterion())
@@ -123,12 +121,10 @@ def test_distri_indivisible_shard_names_the_axis():
 
 
 @pytest.mark.slow
-def test_distri_accumulated_matches_full_batch():
+def test_distri_accumulated_matches_full_batch(fake_mesh):
     """Same parity through the DistriOptimizer's ZeRO-1 shard_map cycle
     on the virtual 8-device mesh: accumulation is collective-free, so
     the sharded update sees the identical mean gradient."""
-    if jax.device_count() < 8:
-        pytest.skip("needs the 8-device CPU mesh")
     from bigdl_tpu.parallel import DistriOptimizer
 
     def run(accum):

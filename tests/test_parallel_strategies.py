@@ -9,8 +9,10 @@ import pytest
 from bigdl_tpu.parallel.mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS,
                                      PIPELINE_AXIS, create_mesh)
 
-pytestmark = pytest.mark.skipif(
-    jax.device_count() < 8, reason="needs the 8-device CPU mesh")
+# every test here runs on the shared conftest fake_mesh fixture (skips
+# with a diagnostic when the 8-device XLA flag didn't take, instead of
+# each file re-checking jax.device_count() its own way)
+pytestmark = pytest.mark.usefixtures("fake_mesh")
 
 
 class TestTensorParallel:

@@ -1,0 +1,409 @@
+"""Mesh-sliced serving: device topology, slot carving, tensor-parallel
+placed engines, and placement-aware replica sets — all on the conftest
+8-virtual-device CPU mesh.  Oracles are the unsharded single-device
+engines (GSPMD guarantees the numerics; fp reduction reorder means
+allclose at 1e-5, and the LM greedy path is token-exact)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.resilience import faults
+from bigdl_tpu.serving.placement import (DeviceTopology, MeshSlice,
+                                         MeshSlicer, PlacementError,
+                                         PlacementPolicy, serving_tp_rules,
+                                         shard_params_chunked)
+
+pytestmark = pytest.mark.usefixtures("fake_mesh")
+
+
+@pytest.fixture
+def inject(monkeypatch):
+    def _inject(spec: str, seed: int = 0):
+        monkeypatch.setenv(faults.ENV_SPEC, spec)
+        monkeypatch.setenv(faults.ENV_SEED, str(seed))
+        return faults.refresh_from_env()
+
+    yield _inject
+    monkeypatch.delenv(faults.ENV_SPEC, raising=False)
+    monkeypatch.delenv(faults.ENV_SEED, raising=False)
+    faults.refresh_from_env()
+
+
+def _mlp(seed=7):
+    return nn.Sequential(nn.Linear(16, 64), nn.ReLU(),
+                         nn.Linear(64, 64), nn.ReLU(),
+                         nn.Linear(64, 10)).build(seed)
+
+
+# --------------------------------------------------------------------------- #
+# topology / slicer / policy units                                            #
+# --------------------------------------------------------------------------- #
+
+def test_topology_detects_the_fake_mesh(fake_mesh):
+    topo = DeviceTopology.detect()
+    assert topo.n_devices >= 8
+    assert topo.platform == "cpu"
+    assert not topo.degraded
+    d = topo.describe()
+    assert d["n_devices"] == topo.n_devices
+    assert len(d["devices"]) == topo.n_devices
+    assert d["devices"][0].keys() == {"id", "platform", "process_index"}
+
+
+def test_topology_degrades_gracefully_when_backend_unreachable():
+    """A dead backend yields an empty degraded topology, not a hang or
+    a raise; carving anything from it is a loud PlacementError."""
+    topo = DeviceTopology(devices=(), degraded=True)
+    assert topo.n_devices == 0 and topo.platform == "unknown"
+    with pytest.raises(PlacementError, match="degraded"):
+        MeshSlicer(topo).carve(1, tp=1)
+
+
+def test_slicer_carves_disjoint_contiguous_slots(fake_mesh):
+    slicer = MeshSlicer(DeviceTopology(fake_mesh))
+    assert slicer.max_slots(tp=2) == 4
+    assert slicer.max_slots(tp=4) == 2
+    slices = slicer.carve(2, tp=2)
+    assert [s.slot_id for s in slices] == [0, 1]
+    assert [s.tp for s in slices] == [2, 2]
+    ids = [s.device_ids for s in slices]
+    assert ids[0] == (0, 1) and ids[1] == (2, 3)  # contiguous, disjoint
+    assert slices[0].tag != slices[1].tag
+    # each slot's mesh is a 1-D model axis over exactly its devices
+    from bigdl_tpu.parallel.mesh import MODEL_AXIS
+    assert slices[0].mesh.shape[MODEL_AXIS] == 2
+    with pytest.raises(PlacementError, match="cannot carve"):
+        slicer.carve(3, tp=4)
+
+
+def test_policy_acquire_release_headroom(fake_mesh):
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=3, tp=2)
+    assert pol.slots_total == 3 and pol.headroom() == 3
+    a = pol.acquire()
+    b = pol.acquire()
+    assert a.slot_id == 0 and b.slot_id == 1
+    assert pol.headroom() == 1
+    assert pol.acquire().slot_id == 2
+    assert pol.acquire() is None          # packed: refuse, don't stack
+    pol.release(a)
+    assert pol.headroom() == 1
+    assert pol.acquire().slot_id == 0     # lowest-id free slot first
+    pol.release(b)
+    with pytest.raises(PlacementError, match="twice"):
+        pol.release(b)
+    foreign = MeshSlice(9, fake_mesh[6:8], 2)
+    with pytest.raises(PlacementError, match="not carved"):
+        pol.release(foreign)
+    st = pol.stats()
+    assert st["slots_total"] == 3 and st["devices_per_slot"] == 2
+
+
+def test_policy_publishes_obs_gauges(fake_mesh):
+    from bigdl_tpu.obs import get_registry
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=2, tp=4)
+    reg = get_registry()
+    assert reg.gauge("serving/placement/slots_total").value == 2
+    assert reg.gauge("serving/placement/devices_per_slot").value == 4
+    pol.acquire()
+    assert reg.gauge("serving/placement/slots_used").value == 1
+
+
+# --------------------------------------------------------------------------- #
+# sharding rules + chunked sharded transfer                                   #
+# --------------------------------------------------------------------------- #
+
+def test_serving_tp_rules_alternate_col_row_with_divisibility_guard(fake_mesh):
+    """nn.Linear weight is (out, in): col-parallel shards dim 0, row
+    shards dim 1; the final (out=10,) head and row-parallel bias
+    degrade to replicated because TP=2 doesn't divide them."""
+    from jax.sharding import PartitionSpec as P
+    model = _mlp()
+    slot = MeshSlicer(DeviceTopology(fake_mesh)).carve(1, tp=2)[0]
+    rules = serving_tp_rules(model, slot.mesh)
+    specs = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(model.params)[0]:
+        s = rules(path, leaf)
+        specs[jax.tree_util.keystr(path)] = s.spec if s is not None else None
+    assert specs["['0']['weight']"] == P("model", None)   # col
+    assert specs["['0']['bias']"] == P("model")
+    assert specs["['2']['weight']"] == P(None, "model")   # row
+    assert specs["['2']['bias']"] is None                 # full after psum
+    assert specs["['4']['weight']"] == P("model", None)   # col again
+    assert specs["['4']['bias']"] == P("model")           # 10 % 2 == 0
+    # divisibility guard: TP4 cannot divide the (out=10,) head bias
+    tp4 = MeshSlicer(DeviceTopology(fake_mesh)).carve(1, tp=4)[0]
+    rules4 = serving_tp_rules(model, tp4.mesh)
+    for path, leaf in jax.tree_util.tree_flatten_with_path(model.params)[0]:
+        if jax.tree_util.keystr(path) == "['4']['bias']":
+            assert rules4(path, leaf) is None             # degrades, no raise
+
+
+def test_shard_params_chunked_lands_sharded_and_intact(fake_mesh):
+    model = _mlp()
+    slot = MeshSlicer(DeviceTopology(fake_mesh)).carve(1, tp=2)[0]
+    rules = serving_tp_rules(model, slot.mesh)
+    sharded = shard_params_chunked(model.params, rules, slot.mesh)
+    w0 = sharded["0"]["weight"]
+    assert set(d.id for d in w0.sharding.device_set) == {0, 1}
+    assert w0.sharding.spec == jax.sharding.PartitionSpec("model", None)
+    for a, b in zip(jax.tree_util.tree_leaves(sharded),
+                    jax.tree_util.tree_leaves(model.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_chunked_device_put_sharded_multi_chunk_rounds_rows(fake_mesh):
+    """A multi-chunk sharded upload must round each chunk's rows to the
+    dim-0 shard count and still reassemble exactly."""
+    from bigdl_tpu.utils.transfer import chunked_device_put
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    slot = MeshSlicer(DeviceTopology(fake_mesh)).carve(1, tp=2)[0]
+    sh = NamedSharding(slot.mesh, P("model", None))
+    x = np.random.RandomState(0).randn(64, 128).astype(np.float32)
+    # tiny chunks force several slices (row bytes = 512)
+    out = chunked_device_put(x, chunk_bytes=4096, min_chunk_bytes=1024,
+                             device=sh)
+    assert out.sharding == sh
+    np.testing.assert_array_equal(np.asarray(out), x)
+
+
+def test_compile_cache_keys_separate_placements():
+    from bigdl_tpu.serving.compile_cache import CompileCache
+    fn = lambda p, b, x: x
+    a = CompileCache(fn, placement_tag="slot0:tp2:d0,1")
+    b = CompileCache(fn, placement_tag="slot1:tp2:d2,3")
+    x = np.zeros((4, 8), np.float32)
+    assert a.key_for(x) != b.key_for(x)
+    assert a.key_for(x)[:3] == b.key_for(x)[:3]  # only the tag differs
+
+
+# --------------------------------------------------------------------------- #
+# placed engines vs the unsharded oracle                                      #
+# --------------------------------------------------------------------------- #
+
+def _oracle_and_batch():
+    from bigdl_tpu.serving import ServingEngine
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    with ServingEngine(_mlp(), input_shape=(16,), buckets=(8,),
+                       name="oracle") as eng:
+        eng.warmup()
+        y = eng._run_batch(x)
+    return x, y
+
+
+@pytest.mark.parametrize("slots,tp", [(2, 2), (1, 4)])
+def test_engine_tp_slot_matches_unsharded_oracle(fake_mesh, slots, tp):
+    """THE tentpole acceptance: a model served tensor-parallel across a
+    slot's devices agrees with the single-device engine, and warmup
+    means traffic is all cache hits."""
+    from bigdl_tpu.serving import ServingEngine
+    x, y0 = _oracle_and_batch()
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=slots, tp=tp)
+    with ServingEngine(_mlp(), input_shape=(16,), buckets=(8,),
+                       name=f"tp{tp}", placement=pol.acquire()) as eng:
+        assert eng.warmup() == 1
+        y1 = eng._run_batch(x)
+        np.testing.assert_allclose(y1, y0, atol=1e-5)
+        st = eng.stats()
+        assert st["compile_cache"]["hit_rate"] == 1.0
+        assert st["placement"]["tp"] == tp
+
+
+def test_engine_tp_slot_int8_matches_unsharded_int8_oracle(fake_mesh):
+    """Quantized params ride the same rules: QTensor q and (out, 1)
+    scale shard together column-parallel, scale replicates under row."""
+    from bigdl_tpu.serving import ServingEngine
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    with ServingEngine(_mlp().quantize(), input_shape=(16,), buckets=(8,),
+                       name="oq") as qo:
+        y0 = qo._run_batch(x)
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=1, tp=2)
+    with ServingEngine(_mlp().quantize(), input_shape=(16,), buckets=(8,),
+                       name="tpq", placement=pol.acquire()) as qe:
+        assert qe.quant_dtype == "int8"
+        assert qe._quant_bytes_staged > 0
+        np.testing.assert_allclose(qe._run_batch(x), y0, atol=1e-5)
+        w = qe._params["0"]["weight"]
+        assert w.q.sharding.spec == jax.sharding.PartitionSpec("model", None)
+        assert w.scale.sharding.spec == jax.sharding.PartitionSpec(
+            "model", None)
+
+
+def test_placed_input_stager_lands_on_the_slot(fake_mesh):
+    from bigdl_tpu.serving import ServingEngine
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=2, tp=2)
+    slot = pol.acquire()
+    with ServingEngine(_mlp(), input_shape=(16,), buckets=(8,),
+                       name="st", placement=slot) as eng:
+        xd = eng.stager.stage(np.zeros((8, 16), np.float32))
+        assert set(d.id for d in xd.sharding.device_set) \
+            == set(slot.device_ids)
+
+
+# --------------------------------------------------------------------------- #
+# placement-aware ReplicaSet                                                  #
+# --------------------------------------------------------------------------- #
+
+def test_replicaset_two_slots_tp2_matches_oracle(fake_mesh):
+    from bigdl_tpu.resilience import ReplicaSet
+    x, y0 = _oracle_and_batch()
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=2, tp=2)
+    rs = ReplicaSet(_mlp(), n_replicas=2, input_shape=(16,), buckets=(8,),
+                    max_batch_size=8, max_wait_ms=1.0, placement=pol)
+    try:
+        rs.warmup()
+        np.testing.assert_allclose(rs.predict(x, timeout=60), y0, atol=1e-5)
+        st = rs.stats()
+        assert st["replicas"]["r0"]["placement"]["device_ids"] == [0, 1]
+        assert st["replicas"]["r1"]["placement"]["device_ids"] == [2, 3]
+        assert st["placement"]["slots_used"] == 2
+    finally:
+        rs.close()
+    assert pol.headroom() == 2  # close released both slots
+
+
+def test_replicaset_int8_two_slots_matches_int8_oracle(fake_mesh):
+    from bigdl_tpu.resilience import ReplicaSet
+    from bigdl_tpu.serving import ServingEngine
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    with ServingEngine(_mlp().quantize(), input_shape=(16,),
+                       buckets=(8,), name="oq") as qo:
+        y0 = qo._run_batch(x)
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=2, tp=2)
+    rs = ReplicaSet(_mlp().quantize(), n_replicas=2, input_shape=(16,),
+                    buckets=(8,), max_batch_size=8, max_wait_ms=1.0,
+                    placement=pol)
+    try:
+        rs.warmup()
+        np.testing.assert_allclose(rs.predict(x, timeout=60), y0, atol=1e-5)
+    finally:
+        rs.close()
+
+
+def test_replicaset_refuses_more_replicas_than_slots(fake_mesh):
+    from bigdl_tpu.resilience import ReplicaSet
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=2, tp=2)
+    with pytest.raises(PlacementError, match="exhausted"):
+        ReplicaSet(_mlp(), n_replicas=3, input_shape=(16,), buckets=(8,),
+                   max_batch_size=8, placement=pol)
+
+
+def test_scale_to_is_headroom_capped_and_releases_on_shrink(fake_mesh):
+    from bigdl_tpu.resilience import ReplicaSet
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=3, tp=2)
+    rs = ReplicaSet(_mlp(), n_replicas=2, input_shape=(16,), buckets=(8,),
+                    max_batch_size=8, max_wait_ms=1.0, placement=pol)
+    try:
+        # ask for 5: only 1 slot is free, growth stops at 3
+        assert rs.scale_to(5) == 3
+        assert pol.headroom() == 0
+        assert rs.try_scale_up() is False   # packed -> refuse
+        assert rs.scale_to(1) == 1          # shrink releases slots
+        assert pol.headroom() == 2
+        assert rs.try_scale_up() is True    # room again
+    finally:
+        rs.close()
+
+
+def test_replica_death_failover_with_placement_loses_no_requests(
+        fake_mesh, inject):
+    """The acceptance criterion: replica death with placement ON still
+    loses zero accepted requests — the batch fails over to the other
+    slot and outputs stay exact."""
+    from bigdl_tpu.resilience import ReplicaSet
+    from bigdl_tpu.serving import ServingEngine
+
+    model = _mlp()
+    xs = np.random.RandomState(3).randn(12, 16).astype(np.float32)
+    with ServingEngine(model, input_shape=(16,), max_batch_size=4,
+                       max_wait_ms=1.0) as single:
+        expected = [single.predict(xs[i:i + 1], timeout=60)
+                    for i in range(len(xs))]
+
+    inject("serving.dispatch:die:name=r1,after=3")
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=2, tp=2)
+    rs = ReplicaSet(model, n_replicas=2, input_shape=(16,),
+                    max_batch_size=4, max_wait_ms=1.0,
+                    failure_threshold=2, cooldown_s=300.0, placement=pol)
+    try:
+        got = [rs.predict(xs[i:i + 1], timeout=60) for i in range(len(xs))]
+        for g, e in zip(got, expected):
+            np.testing.assert_allclose(g, e, atol=1e-5)
+        st = rs.stats()
+        assert st["replicas"]["r1"]["state"] == "open"
+        assert st["replicas"]["r0"]["state"] == "healthy"
+        # the dead replica keeps its slot (it may half-open and recover)
+        assert st["replicas"]["r1"]["placement"]["slot_id"] == 1
+    finally:
+        rs.close()
+
+
+def test_slo_ladder_falls_to_admission_when_placement_is_packed(fake_mesh):
+    """Satellite 6: SLOController.scale_up wired to try_scale_up falls
+    through to admission tightening instead of oversubscribing devices."""
+    from bigdl_tpu.obs.registry import Histogram
+    from bigdl_tpu.resilience import ReplicaSet
+    from bigdl_tpu.traffic import SLOController
+
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=2, tp=4)
+    rs = ReplicaSet(_mlp(), n_replicas=1, input_shape=(16,), buckets=(8,),
+                    max_batch_size=8, max_wait_ms=1.0, placement=pol)
+    try:
+        h = Histogram()
+        adm = []
+        c = SLOController(histogram=h, target_p99_s=0.01,
+                          window_intervals=2,
+                          scale_up=rs.try_scale_up,
+                          set_admission=adm.append,
+                          admission_levels=[64, 8],
+                          hot_streak=1, cool_streak=99)
+        for _ in range(6):
+            h.observe(5.0)
+            c.tick()
+        actions = [a["action"] for a in c.actions]
+        # one real scale-up (the free slot), then the refusal flips the
+        # ladder to admission instead of stacking a 3rd replica
+        assert actions[0] == "scale_up"
+        assert "admission_tighten" in actions
+        assert adm == [8]
+        assert rs.stats()["placement"]["slots_used"] == 2
+    finally:
+        rs.close()
+
+
+# --------------------------------------------------------------------------- #
+# LM engine placement                                                         #
+# --------------------------------------------------------------------------- #
+
+def test_lm_engine_tp_slot_is_token_exact(fake_mesh):
+    """Greedy decode through a TP2 slot replays the unplaced engine's
+    streams token for token (prefill, paged insert, and decode all ride
+    slot-committed executables)."""
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.serving import LMServingEngine
+
+    def mk():
+        m = TransformerLM(vocab_size=64, hidden_size=32, n_head=4,
+                          n_layers=2, ffn_size=64, max_len=64,
+                          attention_impl="xla")
+        m.build(3)
+        return m
+
+    prompts = [np.array([3, 5, 7, 9]), np.array([2, 4, 6, 8, 10, 12])]
+    kw = dict(slots=2, cache_len=32, max_new_tokens=8, prefill_buckets=(8,),
+              enable_prefix_cache=False)
+    base = LMServingEngine(mk(), name="b", **kw)
+    base.warmup()
+    ref = [list(base.submit(p).tokens()) for p in prompts]
+    base.close()
+
+    pol = PlacementPolicy(DeviceTopology(fake_mesh), slots=1, tp=2)
+    eng = LMServingEngine(mk(), name="tp", placement=pol.acquire(), **kw)
+    try:
+        eng.warmup()
+        got = [list(eng.submit(p).tokens()) for p in prompts]
+        assert got == ref
+        assert eng.stats()["placement"]["tp"] == 2
+    finally:
+        eng.close()

@@ -239,8 +239,9 @@ def test_compile_cache_f32_and_int8_coexist():
     y_f32 = cache(m.params, m.buffers, x)
     y_q = cache(q.params, q.buffers, x)
     assert len(cache) == 2                    # same shape, distinct entries
-    tags = sorted(k[-1] for k in cache._entries)  # params dtype tag
+    tags = sorted(k[2] for k in cache._entries)  # params dtype tag
     assert tags == ["f32", "int8"]
+    assert {k[3] for k in cache._entries} == {""}  # unplaced engines share one tag
     # both executables live: re-running either is a hit, not a recompile
     misses = cache.misses
     cache(m.params, m.buffers, x)
