@@ -66,7 +66,12 @@ else
   BENCH_FLOOR=100            # a degraded-window crawl is not a result
   BENCH_ITERS=20
   ATTN_ARGS="--sweep 2048,8192,16384,32768 --naive --useTuned --iters 5"
-  TUNE_ARGS="--sweep 2048,8192 --iters 3 --grid 128:128,128:256,256:256,256:512,512:512,512:1024 --paged"
+  # paged duel pinned to the committed TUNE_ATTN rows (slots 4 / cache
+  # 512 / iters 3): the winner key is (head_dim, block_len, dtype) so
+  # the shape doesn't change the verdict, but matching the identity
+  # lets a rerun reuse instead of re-measuring ~25 min on CPU — and a
+  # smaller duel is tunnel-safer when a TPU window does open.
+  TUNE_ARGS="--sweep 2048,8192 --iters 3 --grid 128:128,128:256,256:256,256:512,512:512,512:1024 --paged --paged-iters 3 --slots 4 --cache-len 512 --block-len 16"
   LM_ARGS="--sweep 2048,8192,16384 -b 8 -t 2048 --flash --remat -i 5"
   PIPE_ARGS="--batch 256 --iters 15 --records 2048"
   PROF_ARGS="--batches 256,512,1024 --iters 15 --flag-sweep --deadline 1100 --timeout 500"

@@ -71,6 +71,35 @@ def test_resume_reuses_only_identity_matched_rows(tmp_path):
     assert not any(r.get("reused_from_previous_run") for r in doc3["rows"])
 
 
+def test_certified_doc_survives_allreuse_and_killed_reruns(tmp_path,
+                                                           monkeypatch):
+    """A complete:true doc must not be rewritten by a rerun until a
+    candidate genuinely re-measures — an all-reuse pass, or one killed
+    mid-measurement of its first new candidate (the opportunist's
+    timeout), leaves the certified artifact byte-identical."""
+    path = str(tmp_path / "tune.json")
+    doc = autotune.autotune_attention([32], iters=1, path=path, **TINY)
+    assert doc["complete"] is True
+    certified = open(path, "rb").read()
+    # all-reuse rerun: reported, but the file is untouched
+    doc2 = autotune.autotune_attention([32], iters=1, path=path, **TINY)
+    assert doc2["complete"] is True
+    assert all(r.get("reused_from_previous_run") for r in doc2["rows"])
+    assert open(path, "rb").read() == certified
+    # wider grid whose first NEW candidate dies mid-measure (simulated
+    # kill): the interim flush must not have regressed complete:true
+    fa_mod = sys.modules["bigdl_tpu.ops.flash_attention"]
+
+    def _killed(*a, **k):
+        raise KeyboardInterrupt
+
+    monkeypatch.setattr(fa_mod, "flash_attention", _killed)
+    wider = dict(TINY, grid=((8, 8), (8, 16), (16, 16)))
+    with pytest.raises(KeyboardInterrupt):
+        autotune.autotune_attention([32], iters=1, path=path, **wider)
+    assert open(path, "rb").read() == certified
+
+
 def test_other_config_rows_accumulate_across_sweeps(tmp_path):
     path = str(tmp_path / "tune.json")
     autotune.autotune_attention([32], iters=1, path=path, **TINY)
