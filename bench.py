@@ -1131,11 +1131,18 @@ def _serve_lm_stage_continuous(eng, model, work, probes: int) -> dict:
                                   prompt[None], max_new))
         exact += int(np.array_equal(out, ref[0]))
     span = t_end - t0
+    spec_snap = (eng.spec_metrics.snapshot()
+                 if getattr(eng, "spec_metrics", None) is not None else None)
     return {
         "requests": len(work),
         "tokens": useful,
         "duration_s": round(span, 3),
         "tokens_per_s": round(useful / span, 2),
+        "spec": spec_snap is not None,
+        "accept_rate": (round(spec_snap["acceptance_rate"], 4)
+                        if spec_snap is not None
+                        and spec_snap["acceptance_rate"] is not None
+                        else None),
         "ttft": _percentiles_ms(ttfts),
         "itl_p50_ms": (round(snap["itl"]["p50_s"] * 1000.0, 3)
                        if snap["itl"]["p50_s"] is not None else None),
@@ -1243,6 +1250,7 @@ def _serve_lm_bench(argv) -> int:
               "cache_len": args.cache_len,
               "layout": "paged", "block_len": args.block_len,
               "decode_attn": ["gather", "paged_kernel"],
+              "spec": False,
               "requests": args.requests,
               "mean_gap_ms": args.mean_gap_ms,
               "prompt_lens": list(_LM_PROMPT_LENS),
@@ -1371,6 +1379,167 @@ def _serve_lm_bench(argv) -> int:
                       file=sys.stderr)
             except OSError:
                 pass
+
+
+# ---------------------------------------------------------------------------
+# --serve-lm --spec: speculative decoding vs plain decode -> BENCH_SPEC.json
+# ---------------------------------------------------------------------------
+
+
+def _serve_lm_spec_bench(argv) -> int:
+    """Speculative-decoding serving benchmark -> BENCH_SPEC.json.
+
+    Replays ONE arrival trace twice: through a spec engine (int8 draft +
+    one donated verify executable, k candidates per slot) and through a
+    plain engine — same model, same slots, same schedule.  Because
+    replay acceptance makes the spec stream the offline trajectory
+    bit-for-bit, BOTH stages run the same exactness probes and the
+    artifact only certifies (``complete: true``) when the spec stage's
+    agreement is exactly 1.0; the speedup number is meaningless if the
+    streams diverge.  Same resumable-artifact contract as --serve-lm."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --serve-lm --spec")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--requests", type=int, default=int(
+        os.environ.get("BIGDL_TPU_SERVE_LM_REQUESTS", "24")))
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--cache-len", type=int, default=128)
+    ap.add_argument("--block-len", type=int, default=16)
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens per verify round")
+    ap.add_argument("--mean-gap-ms", type=float, default=15.0)
+    ap.add_argument("--probes", type=int, default=2,
+                    help="requests probed for bit-exactness vs offline "
+                         "generate (both stages; spec must score 1.0)")
+    args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_SPEC.json")
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import jax
+    import numpy as np
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.serving import LMServingEngine, SpecConfig
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    config = {"model": "transformer_lm", "vocab": 256, "hidden": 128,
+              "heads": 4, "layers": 4, "max_len": args.cache_len,
+              "pos": "rope", "slots": args.slots,
+              "cache_len": args.cache_len,
+              "layout": "paged", "block_len": args.block_len,
+              "decode_attn": "gather",
+              "spec_k": args.spec_k, "sampling": "replay",
+              "drafter": "int8_clone",
+              "requests": args.requests,
+              "mean_gap_ms": args.mean_gap_ms,
+              "prompt_lens": list(_LM_PROMPT_LENS),
+              "max_news": list(_LM_MAX_NEWS)}
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "lm_serving_speculative_decoding",
+              "platform": platform,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+    model = TransformerLM(
+        vocab_size=config["vocab"], hidden_size=config["hidden"],
+        n_head=config["heads"], n_layers=config["layers"],
+        max_len=args.cache_len, pos_encoding="rope").build(seed=7)
+    work = _lm_workload(args.requests, config["vocab"],
+                        args.mean_gap_ms, np.random.RandomState(0))
+
+    def _spec_stage():
+        eng = LMServingEngine(model, slots=args.slots,
+                              cache_len=args.cache_len,
+                              block_len=args.block_len,
+                              max_queue=max(args.requests, 256),
+                              spec=SpecConfig(k=args.spec_k),
+                              name="lm-spec")
+        try:
+            t0 = time.perf_counter()
+            eng.warmup()  # prefill buckets + verify exec + drafter
+            warm_s = round(time.perf_counter() - t0, 3)
+            row = _serve_lm_stage_continuous(eng, model, work, args.probes)
+            row["warmup_s"] = warm_s
+            spec = eng.stats()["spec"]
+            row["draft_overhead"] = (round(spec["draft_overhead"], 4)
+                                     if spec["draft_overhead"] is not None
+                                     else None)
+            row["drafted"] = spec["drafted"]
+            row["demotions"] = spec["demotions"]
+            row["verify_compiles"] = eng._verify_compiles
+            row["draft_decode_compiles"] = eng.draft.decode_compiles
+            return row
+        finally:
+            eng.close()
+
+    def _plain_stage():
+        eng = LMServingEngine(model, slots=args.slots,
+                              cache_len=args.cache_len,
+                              block_len=args.block_len,
+                              max_queue=max(args.requests, 256),
+                              name="lm-plain")
+        try:
+            eng.warmup()
+            return _serve_lm_stage_continuous(eng, model, work, args.probes)
+        finally:
+            eng.close()
+
+    stages = {"spec": _spec_stage, "baseline": _plain_stage}
+    for name, run in stages.items():
+        if name in prev:
+            row = dict(prev[name])
+            row["reused_from_previous_run"] = True
+        else:
+            row = {"stage": name, **run()}
+        rows.append(row)
+        flush()
+
+    spec_row = next(r for r in rows if r.get("stage") == "spec")
+    base_row = next(r for r in rows if r.get("stage") == "baseline")
+    if args.probes and spec_row["agreement"] != 1.0:
+        print(f"bench: SPEC AGREEMENT {spec_row['agreement']} != 1.0 — "
+              "speculative streams diverged from offline generate; "
+              "artifact left incomplete", file=sys.stderr)
+        flush()
+        return 1
+    speedup = (spec_row["tokens_per_s"] / base_row["tokens_per_s"]
+               if base_row["tokens_per_s"] else None)
+    result["summary"] = {
+        "tokens_per_s": spec_row["tokens_per_s"],
+        "baseline_tokens_per_s": base_row["tokens_per_s"],
+        "spec_speedup": round(speedup, 3) if speedup is not None else None,
+        "acceptance_rate": spec_row["accept_rate"],
+        "draft_overhead": spec_row.get("draft_overhead"),
+        "itl_p50_ms": spec_row["itl_p50_ms"],
+        "baseline_itl_p50_ms": base_row["itl_p50_ms"],
+        "agreement": spec_row["agreement"],
+        "baseline_agreement": base_row["agreement"],
+        "spec_k": args.spec_k,
+    }
+    result["complete"] = True
+    flush()
+    print(json.dumps({
+        "metric": "lm_serving_spec_tokens_per_sec",
+        "value": spec_row["tokens_per_s"],
+        "unit": "tokens/sec", "platform": platform,
+        **{k: v for k, v in result["summary"].items()
+           if k != "tokens_per_s"}}), flush=True)
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -1967,6 +2136,10 @@ if __name__ == "__main__":
         sys.exit(_attn_bench([a for a in sys.argv[1:] if a != "--attn"]))
     if "--slo" in sys.argv:
         sys.exit(_slo_bench([a for a in sys.argv[1:] if a != "--slo"]))
+    if "--serve-lm" in sys.argv and "--spec" in sys.argv:
+        sys.exit(_serve_lm_spec_bench(
+            [a for a in sys.argv[1:]
+             if a not in ("--serve-lm", "--spec")]))
     if "--serve-lm" in sys.argv and "--prefix" in sys.argv:
         sys.exit(_serve_lm_prefix_bench(
             [a for a in sys.argv[1:]

@@ -310,6 +310,88 @@ def _decode_step_paged(model, params, token, pos, tables, k_arena,
     return logits.astype(jnp.float32), k_arena, v_arena
 
 
+def _verify_step_paged(model, params, tokens, pos, n_cand, tables,
+                       k_arena, v_arena):
+    """Speculative VERIFY over paged caches: score all W = k+1 candidate
+    rows per slot in one fixed-shape step.  ``tokens`` (S, W) int32
+    0-based — row layout ``[last_emitted, draft_1 .. draft_k]`` — and
+    ``pos`` (S,) is each slot's next write position, so candidate j sits
+    at absolute position ``pos + j``.  ``n_cand`` (S,) int32 counts the
+    VALID rows per slot (1 for a plain-decode slot, 0 for an idle slot);
+    padded rows' k/v writes are redirected to the scratch block so they
+    can never touch a live position.  Returns (logits (S, W, V) f32,
+    arenas') — logits row j is the target distribution for the token
+    AFTER candidate j, i.e. exactly what ``_decode_step_paged`` would
+    have produced had rows 0..j been fed one at a time.
+
+    Rollback is pointer-only: a rejected row's k/v stays in the arena as
+    garbage ABOVE the slot's rewound position pointer, where the
+    position mask (`<= pos + j`) hides it until a later write overwrites
+    that offset — the same stale-row invariant the plain decode step
+    already relies on for recycled blocks.  Attention always uses the
+    dense gather (the Pallas paged kernel is single-query); its f32
+    score/softmax math is identical to ``_decode_step_paged``'s gather
+    branch, so emitted streams stay token-exact with every decode_attn
+    setting."""
+    mha = model._mha
+    s, w = tokens.shape
+    m = tables.shape[1]
+    B = k_arena.shape[3]
+    ctx = m * B
+    offs = jnp.arange(w)
+    abspos = pos[:, None] + offs[None, :]            # (S, W)
+    h = params["embed"][tokens]                      # (S, W, hidden)
+    if model.pos_encoding == "learned":
+        # clamp: padded rows of a near-full slot may index past the table
+        h = h + params["pos"][jnp.minimum(abspos, params["pos"].shape[0] - 1)]
+    # (S, 1, W): broadcasts against (S, H, W, half) inside apply_rope
+    positions = abspos[:, None, :]
+    # row j attends positions <= pos + j: (S, 1, W, ctx)
+    mask = (jnp.arange(ctx)[None, None, :] <= abspos[:, :, None])[:, None]
+    # scatter targets: candidate j writes block tables[s, (pos+j) // B] at
+    # offset (pos+j) % B.  Two safety redirects: the column index clamps
+    # to the table width (a padded row of a chain-filling slot would
+    # otherwise gather-clamp onto the LAST real block), and rows >=
+    # n_cand go to the scratch block outright.
+    rowsel = jnp.arange(s)[:, None]
+    blkcol = jnp.minimum(abspos // B, m - 1)
+    blk = jnp.where(offs[None, :] < n_cand[:, None],
+                    tables[rowsel, blkcol], 0)       # (S, W)
+    off = abspos % B
+
+    def body(carry, layer):
+        h = carry
+        bp, kc, vc = layer          # kc/vc: (N, H, B, D) one layer
+        q, k, v = _block_qkv(model, bp, h)  # (S, H, W, D)
+        q, k = model._rope(q, k, positions)
+        # advanced-index write: (S, W) block/offset pairs each take an
+        # (H, D) row — update shaped (S, W, H, D)
+        kc = kc.at[blk, :, off, :].set(
+            k.transpose(0, 2, 1, 3).astype(kc.dtype))
+        vc = vc.at[blk, :, off, :].set(
+            v.transpose(0, 2, 1, 3).astype(vc.dtype))
+        kg = kc[tables].transpose(0, 2, 1, 3, 4).reshape(
+            s, mha.n_head, ctx, mha.head_dim)
+        vg = vc[tables].transpose(0, 2, 1, 3, 4).reshape(
+            s, mha.n_head, ctx, mha.head_dim)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            kg.astype(jnp.float32))
+        scores = scores / jnp.sqrt(jnp.float32(mha.head_dim))
+        scores = jnp.where(mask, scores, -1e30)
+        wts = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", wts, vg.astype(jnp.float32))
+        h = _finish_block(model, bp, h, o.astype(h.dtype))
+        return h, (kc, vc)
+
+    h, (k_arena, v_arena) = lax.scan(
+        body, h, (params["blocks"], k_arena, v_arena))
+    h = model._layer_norm(params["ln_f"], h)
+    head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
+            else params["head"].astype(h.dtype))
+    logits = h @ head                                # (S, W, V)
+    return logits.astype(jnp.float32), k_arena, v_arena
+
+
 def _decode_step(model, params, token, pos, k_cache, v_cache):
     """One cached decode step for a homogeneous batch: token (B,)
     0-based, pos scalar index of the position being *written* (one

@@ -17,7 +17,10 @@ Spec grammar (``;``-separated specs)::
     BIGDL_TPU_FAULTS="site:kind[:key=val[,key=val...]][;spec...]"
 
     site   hook-point name: transfer.chunk | engine.init |
-           serving.dispatch (more may be added freely)
+           serving.dispatch | serving.enqueue | serving.verify
+           (more may be added freely; a transient at serving.verify
+           demotes the speculating slots to plain decode instead of
+           killing their streams — see lm_engine._step_spec)
     kind   transient     raise TransientBackendError
            backend_lost  raise BackendLostError
            die           alias of backend_lost (reads better for

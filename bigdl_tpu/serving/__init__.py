@@ -35,6 +35,7 @@ from bigdl_tpu.serving.placement import (DeviceTopology, MeshSlice,
                                          MeshSlicer, PlacementError,
                                          PlacementPolicy, serving_tp_rules,
                                          shard_params_chunked)
+from bigdl_tpu.serving.spec import DraftModel, SpecConfig, SpecMetrics
 
 __all__ = [
     "ServingEngine", "DynamicBatcher", "CompileCache", "HostStager",
@@ -44,4 +45,5 @@ __all__ = [
     "BlockPool", "RadixCache", "PoolExhausted", "RequestExceedsPool",
     "DeviceTopology", "MeshSlice", "MeshSlicer", "PlacementError",
     "PlacementPolicy", "serving_tp_rules", "shard_params_chunked",
+    "SpecConfig", "DraftModel", "SpecMetrics",
 ]

@@ -186,6 +186,7 @@ class LMMetrics:
     def __init__(self, slots: int, throughput_window_s: float = 60.0):
         self._lock = threading.Lock()
         self.slots = int(slots)
+        self.spec = None  # SpecMetrics when the engine speculates
         self.ttft = Histogram()
         self.itl = Histogram()
         self.requests = 0
@@ -283,6 +284,8 @@ class LMMetrics:
                     if self.slot_steps else None,
                 "ttft": self.ttft.snapshot(),
                 "itl": self.itl.snapshot(),
+                "spec": (self.spec.snapshot()
+                         if self.spec is not None else None),
             }
 
 
@@ -305,7 +308,8 @@ class _Request:
 class _Slot:
     __slots__ = ("stream", "pos_next", "last0", "remaining", "step_idx",
                  "temperature", "eos0", "step_keys", "last_emit_at",
-                 "blocks", "table")
+                 "blocks", "table", "draft_ok", "demoted", "accept_ema",
+                 "spec_rounds", "probe_in")
 
     def __init__(self, req: _Request, prompt_len: int, first0: int,
                  blocks: List[int], table: np.ndarray):
@@ -320,6 +324,12 @@ class _Slot:
         self.last_emit_at = time.perf_counter()
         self.blocks = blocks            # one pool ref per block
         self.table = table              # (M,) int32, scratch-padded
+        # speculation state (spec engines only)
+        self.draft_ok = False           # drafter holds this slot's KV
+        self.demoted = False            # plain decode until re-probe
+        self.accept_ema = None          # acceptance-rate EMA
+        self.spec_rounds = 0            # rounds of EMA evidence
+        self.probe_in = 0               # plain rounds until re-probe
 
 
 # ---------------------------------------------------------------------- #
@@ -360,6 +370,14 @@ class LMServingEngine:
             kernel only when the autotune cache has measured it faster
             than the gather ON THIS device kind, the gather otherwise.
             Both produce token-identical streams.
+        spec: optional :class:`~bigdl_tpu.serving.spec.SpecConfig` (or
+            an int k) enabling draft-verify speculative decoding: a
+            cheap drafter (the target's int8 ``quantize()`` clone by
+            default) proposes k tokens per slot and ONE fixed-shape
+            donated verify executable scores all k+1 candidates per
+            step.  Streams stay bit-exact vs offline generate under the
+            default ``"replay"`` acceptance; a per-slot acceptance EMA
+            demotes collapsing slots to plain decode and re-probes.
     """
 
     def __init__(self, model, *,
@@ -379,12 +397,13 @@ class LMServingEngine:
                  decode_attn: str = "auto",
                  name: str = "lm",
                  placement=None,
-                 tp_rules=None):
+                 tp_rules=None,
+                 spec=None):
         select_platform(platform)
         import jax
         from bigdl_tpu.models.transformer.generate import (
             _decode_step_paged, _insert_blocks, _prefill_parts,
-            _prefill_suffix_parts)
+            _prefill_suffix_parts, _verify_step_paged)
         from bigdl_tpu.quant import dequantize_entry
 
         model._built()
@@ -517,7 +536,50 @@ class LMServingEngine:
             _insert_blocks, donate_argnums=(0, 1) if donate_cache else ())
         self._insert_execs: dict = {}
 
+        # -- speculation (draft-verify) --------------------------------- #
+        self.spec = None
+        self.draft = None
+        self.spec_metrics = None
+        self._verify_jit = None
+        self._verify_exec = None
+        self._verify_compiles = 0
+        if spec is not None:
+            from bigdl_tpu.quant import params_dtype_tag
+            from bigdl_tpu.serving.spec import (DraftModel, SpecConfig,
+                                                SpecMetrics)
+            if isinstance(spec, int):
+                spec = SpecConfig(k=spec)
+            self.spec = spec
+            draft_lm = spec.draft
+            if draft_lm is None:
+                # derive the default drafter: the target's int8 clone
+                # (or the target itself when it is already quantized)
+                draft_lm = (model
+                            if params_dtype_tag(model.params) == "int8"
+                            else model.quantize("int8"))
+            if draft_lm.vocab_size != model.vocab_size:
+                raise ValueError(
+                    f"draft model vocab ({draft_lm.vocab_size}) differs "
+                    f"from the target's ({model.vocab_size}): drafted "
+                    "token ids would not be the target's token ids")
+            self.draft = DraftModel(
+                draft_lm, slots=self.slots, cache_len=self.cache_len,
+                prefill_buckets=self.prefill_buckets,
+                max_cache_entries=max_cache_entries,
+                sampling=spec.sampling, placement_tag=_ptag)
+            self.spec_metrics = SpecMetrics().publish_to(get_registry())
+
+            def _verify_fn(params, tokens, pos, n_cand, tables, kc, vc):
+                return _constrain(_verify_step_paged(
+                    model, dequantize_entry(params), tokens, pos, n_cand,
+                    tables, kc, vc))
+
+            self._verify_jit = jax.jit(
+                _verify_fn,
+                donate_argnums=(5, 6) if donate_cache else ())
+
         self.metrics = LMMetrics(self.slots).publish_to(get_registry())
+        self.metrics.spec = self.spec_metrics
         self._publish_kv_metrics(get_registry())
 
         # -- scheduler state (worker thread owns the slots) ------------- #
@@ -571,7 +633,14 @@ class LMServingEngine:
                    "len": _np.int32(b)} for b in self.prefill_buckets]
         n = self.prefill_cache.warmup_inputs(
             self._params, self._buffers, inputs)
-        self._decode_compiled()
+        if self.draft is not None:
+            # a spec engine decodes through the verify executable (a
+            # plain-decode slot is just an n_cand=1 row); the drafter
+            # warms its own prefill/decode/insert programs
+            self._verify_compiled()
+            self.draft.warmup()
+        else:
+            self._decode_compiled()
         for b in self.prefill_buckets:
             self._insert_compiled(b)
         return n
@@ -628,6 +697,26 @@ class LMServingEngine:
                 self._params, tok, pos, tables,
                 self.pool.k, self.pool.v).compile()
         return self._decode_exec
+
+    def _verify_compiled(self):
+        """The spec engine's single verify executable: all S slots, all
+        W = k+1 candidate rows, every round — k is static per engine
+        and slots pad with n_cand, so like decode this lowers ONCE."""
+        if self._verify_exec is None:
+            import jax
+            sh = (dict(sharding=self.placement.replicated())
+                  if self.placement is not None else {})
+            sds = jax.ShapeDtypeStruct
+            w = self.spec.k + 1
+            tok = sds((self.slots, w), np.int32, **sh)
+            pos = sds((self.slots,), np.int32, **sh)
+            ncand = sds((self.slots,), np.int32, **sh)
+            tables = sds((self.slots, self.table_width), np.int32, **sh)
+            self._verify_exec = self._verify_jit.lower(
+                self._params, tok, pos, ncand, tables,
+                self.pool.k, self.pool.v).compile()
+            self._verify_compiles += 1
+        return self._verify_exec
 
     def _insert_compiled(self, bucket: int):
         exe = self._insert_execs.get(bucket)
@@ -785,16 +874,11 @@ class LMServingEngine:
     @staticmethod
     def _pick(logits_row: np.ndarray, temperature: float, key,
               clamp: bool) -> int:
-        if temperature <= 0.0 or key is None:
-            return int(np.argmax(logits_row))
-        import jax
-        import jax.numpy as jnp
-        # offline shapes exactly: categorical over (1, V) logits; the
-        # first token divides by raw temperature, scan steps clamp
-        denom = max(temperature, 1e-6) if clamp else temperature
-        return int(jax.random.categorical(
-            jnp.asarray(key), jnp.asarray(logits_row)[None, :] / denom,
-            axis=-1)[0])
+        # one shared implementation with the speculative acceptance
+        # path (spec/verify.py), so plain decode, verify rows, and the
+        # Gumbel-coupled drafter can never drift apart
+        from bigdl_tpu.serving.spec.verify import pick_token
+        return pick_token(logits_row, temperature, key, clamp)
 
     # -- worker -------------------------------------------------------- #
     def _run(self):
@@ -835,7 +919,10 @@ class LMServingEngine:
                             self._free.append(slot)
                             self._queue.appendleft(req)
                 if self._n_active:
-                    self._step()
+                    if self.draft is not None:
+                        self._step_spec()
+                    else:
+                        self._step()
         except BaseException as e:  # noqa: BLE001
             self._fail_all(e)
             return
@@ -937,6 +1024,14 @@ class LMServingEngine:
         table = np.zeros((self.table_width,), np.int32)
         table[:len(blocks)] = blocks
         st = _Slot(req, t, first0, blocks, table)
+        if self.draft is not None:
+            # drafter admission: full-prompt prefill into its dense
+            # per-slot cache, first emitted token queued as pending.
+            # Over-length (chunk-admitted) prompts serve plain decode.
+            st.draft_ok = self.draft.can_draft(t)
+            if st.draft_ok:
+                self.draft.admit(slot, req.prompt0)
+                self.draft.push(slot, first0)
         with self._cv:
             self._slots[slot] = st
             self._n_active += 1
@@ -993,6 +1088,161 @@ class LMServingEngine:
                     self._n_active -= 1
                 self._cv.notify_all()
 
+    def _step_spec(self):
+        """One speculative round: draft k tokens per eligible slot, run
+        the SINGLE fixed-shape verify executable over all k+1 candidate
+        rows per slot, then walk each slot's rows host-side emitting
+        the accepted prefix plus one bonus/correction token — the exact
+        offline trajectory under "replay" acceptance.  Rejection is a
+        pointer rewind: the slot simply doesn't advance past the last
+        emitted position, and the arena rows above it stay masked until
+        overwritten.  Demoted / chunk-admitted / budget-exhausted slots
+        ride the same round as plain n_cand=1 rows."""
+        from bigdl_tpu.resilience.faults import fault_point
+        from bigdl_tpu.serving.spec.verify import accept_row
+
+        cfg = self.spec
+        mode = cfg.sampling
+        # -- choose who speculates this round --------------------------- #
+        jobs = {}
+        for i, st in enumerate(self._slots):
+            if st is None or not st.draft_ok:
+                continue
+            if st.demoted:
+                st.probe_in -= 1
+                if st.probe_in > 0:
+                    continue
+                # re-probe: forget the collapsed EMA and try again
+                st.demoted = False
+                st.accept_ema = None
+                st.spec_rounds = 0
+                self.spec_metrics.record_reprobe()
+            # never draft past the budget: the round emits at most
+            # k_eff + 1 tokens, and every verify write must stay inside
+            # the chain allocated for prompt + max_new at admission
+            k_eff = min(cfg.k, st.remaining - 1)
+            if k_eff < 1:
+                continue
+            keys = None
+            if st.temperature > 0.0 and st.step_keys is not None:
+                keys = st.step_keys[st.step_idx:st.step_idx + k_eff]
+            jobs[i] = (k_eff, st.temperature, keys)
+        steps_before = self.draft.steps
+        drafts = self.draft.draft_round(jobs)
+
+        # chaos hook on the verify step: an injected transient demotes
+        # every speculating slot to plain decode for this round (their
+        # drafts are discarded, the drafter pointer rewinds) instead of
+        # killing streams; backend_lost/die keep their fatal meaning
+        try:
+            fault_point("serving.verify", name=self.name,
+                        k=cfg.k, speculating=len(jobs))
+        except TransientBackendError:
+            for i in jobs:
+                st = self._slots[i]
+                self.draft.commit(i, 0, [])
+                st.demoted = True
+                st.probe_in = cfg.probe_interval
+                self.spec_metrics.record_demotion(fault=True)
+            drafts = {}
+            jobs = {}
+
+        # -- one fixed-shape verify over every active slot -------------- #
+        w = cfg.k + 1
+        tokens = np.zeros((self.slots, w), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        ncand = np.zeros((self.slots,), np.int32)
+        tables = np.zeros((self.slots, self.table_width), np.int32)
+        active = []
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            active.append(i)
+            ds, _ = drafts.get(i, ((), None))
+            tokens[i, 0] = st.last0
+            for j, d in enumerate(ds):
+                tokens[i, 1 + j] = d
+            ncand[i] = 1 + len(ds)
+            pos[i] = st.pos_next
+            tables[i] = st.table
+        if not active:
+            return
+        with _tracer.span("lm/verify_step", cat="serve",
+                          active=len(active), speculating=len(jobs)):
+            logits, self.pool.k, self.pool.v = self._verify_compiled()(
+                self._params, tokens, pos, ncand, tables,
+                self.pool.k, self.pool.v)
+            logits = np.asarray(logits)  # sync; (S, W, V) f32
+        now = time.perf_counter()
+        itls = []
+        freed = []
+        n_emitted = 0
+        for i in active:
+            st = self._slots[i]
+            ds, qrows = drafts.get(i, ((), None))
+            k_eff = len(ds)
+            emitted = []
+            accepted = 0
+            finished = False
+            for j in range(k_eff + 1):
+                key = (st.step_keys[st.step_idx]
+                       if st.step_keys is not None else None)
+                e = accept_row(logits[i, j],
+                               ds[j] if j < k_eff else None,
+                               st.temperature, key, mode,
+                               qrows[j] if qrows is not None
+                               and j < k_eff else None)
+                emitted.append(e)
+                st.stream._emit(e + 1)
+                itls.append(now - st.last_emit_at)
+                st.last_emit_at = now
+                st.last0 = e
+                st.pos_next += 1
+                st.step_idx += 1
+                st.remaining -= 1
+                if st.remaining <= 0 or (st.eos0 is not None
+                                         and e == st.eos0):
+                    finished = True
+                    break
+                if j >= k_eff or ds[j] != e:
+                    break
+                accepted += 1
+            n_emitted += len(emitted)
+            if k_eff:
+                self.spec_metrics.record_round(k_eff, accepted)
+                rate = accepted / k_eff
+                st.accept_ema = (rate if st.accept_ema is None
+                                 else cfg.ema_alpha * rate
+                                 + (1.0 - cfg.ema_alpha) * st.accept_ema)
+                st.spec_rounds += 1
+                if (not finished and st.spec_rounds >= cfg.min_rounds
+                        and st.accept_ema < cfg.demote_below):
+                    st.demoted = True
+                    st.probe_in = cfg.probe_interval
+                    self.spec_metrics.record_demotion()
+            if finished:
+                st.stream._finish()
+                self.metrics.record_complete()
+                freed.append(i)
+            elif st.draft_ok:
+                if k_eff:
+                    self.draft.commit(i, accepted, emitted)
+                else:
+                    self.draft.push(i, emitted[0])
+        self.spec_metrics.record_verify_round(
+            bool(jobs), n_emitted, self.draft.steps - steps_before)
+        self.metrics.record_step(len(active), itls)
+        if freed:
+            with self._cv:
+                for i in freed:
+                    self.pool.release(self._slots[i].blocks)
+                    self._slots[i] = None
+                    if self.draft is not None:
+                        self.draft.release(i)
+                    self._free.append(i)
+                    self._n_active -= 1
+                self._cv.notify_all()
+
     def _fail_all(self, error: BaseException) -> None:
         with self._cv:
             pending = [r.stream for r in self._queue]
@@ -1004,6 +1254,8 @@ class LMServingEngine:
                     self._slots[i] = None
                     self._free.append(i)
             self._n_active = 0
+            if self.draft is not None:
+                self.draft.release_all()
         for s in pending:
             s._finish(error=error)
 
@@ -1046,7 +1298,20 @@ class LMServingEngine:
             "prefix_prefill_cache": self.prefix_prefill_cache.stats(),
             "kvcache": self.kvcache_stats(),
             "metrics": self.metrics.snapshot(),
+            "spec": self._spec_stats(),
         }
+
+    def _spec_stats(self) -> Optional[dict]:
+        if self.spec is None:
+            return None
+        with self._cv:
+            demoted = sum(1 for s in self._slots
+                          if s is not None and s.demoted)
+        out = self.spec.describe()
+        out["demoted_slots"] = demoted
+        out["draft"] = self.draft.describe()
+        out.update(self.spec_metrics.snapshot())
+        return out
 
     def cache_buffer_pointers(self) -> tuple:
         """Device buffer addresses of the resident k/v arenas (donation

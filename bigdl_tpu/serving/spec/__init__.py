@@ -1,0 +1,20 @@
+"""Draft-verify speculative decoding for the LM slot engine.
+
+A cheap drafter (the target's int8 ``quantize()`` clone by default)
+proposes k tokens per slot; ONE fixed-shape donated verify executable
+scores all k+1 candidate positions against the paged target cache; the
+host accepts the matching prefix by replaying the offline sampling key
+chain, so greedy AND sampled speculative streams stay bit-exact vs
+offline ``generate()``.  See the module docstrings of
+:mod:`.draft`, :mod:`.verify`, :mod:`.metrics`.
+
+Enable with ``LMServingEngine(model, spec=SpecConfig(k=4))``.
+"""
+from bigdl_tpu.serving.spec.draft import DraftModel
+from bigdl_tpu.serving.spec.metrics import SpecMetrics
+from bigdl_tpu.serving.spec.verify import (SpecConfig, accept_row,
+                                           accept_walk, draft_pick,
+                                           pick_token)
+
+__all__ = ["DraftModel", "SpecConfig", "SpecMetrics", "accept_row",
+           "accept_walk", "draft_pick", "pick_token"]

@@ -1,0 +1,100 @@
+"""Speculation counters, published as ``serving/lm/spec/*``.
+
+Thread-safe (the engine's decode worker records; stats()/ObsSummary
+read).  The two derived rates are the subsystem's health summary:
+``acceptance_rate`` (accepted drafts / drafted — how often the drafter
+earns its keep) and ``draft_overhead`` (drafter decode steps per
+emitted token — the price paid; < 1 means speculation amortizes)."""
+from __future__ import annotations
+
+import threading
+
+from bigdl_tpu.obs.registry import FnGauge, Histogram
+
+
+class SpecMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.drafted = 0          # draft tokens proposed to verify
+        self.accepted = 0         # drafts the target agreed with
+        self.rolled_back = 0      # drafts rejected (pointer rewinds)
+        self.draft_steps = 0      # drafter decode steps executed
+        self.verify_rounds = 0    # verify executions (incl. all-plain)
+        self.spec_rounds = 0      # verify rounds with >= 1 speculating slot
+        self.emitted = 0          # tokens emitted by the spec engine
+        self.demotions = 0        # EMA-collapse demotions
+        self.fault_demotions = 0  # injected-transient demotions
+        self.reprobes = 0         # demoted slots re-probed
+        self.acceptance = Histogram()  # per-(slot, round) acceptance rate
+
+    def publish_to(self, registry,
+                   prefix: str = "serving/lm/spec/") -> "SpecMetrics":
+        for key in ("drafted", "accepted", "rolled_back", "draft_steps",
+                    "verify_rounds", "spec_rounds", "emitted", "demotions",
+                    "fault_demotions", "reprobes"):
+            registry.register(prefix + key,
+                              FnGauge(lambda k=key: getattr(self, k)),
+                              replace=True)
+        registry.register(
+            prefix + "accept_rate",
+            FnGauge(lambda: self.snapshot()["acceptance_rate"]),
+            replace=True)
+        registry.register(
+            prefix + "draft_overhead",
+            FnGauge(lambda: self.snapshot()["draft_overhead"]),
+            replace=True)
+        registry.register(prefix + "acceptance", self.acceptance,
+                          replace=True)
+        return self
+
+    # -- recording ------------------------------------------------------ #
+    def record_round(self, drafted: int, accepted: int) -> None:
+        """One slot's verify-round outcome: ``drafted`` proposals, the
+        leading ``accepted`` of them matched."""
+        with self._lock:
+            self.drafted += drafted
+            self.accepted += accepted
+            self.rolled_back += drafted - accepted
+            if drafted:
+                self.acceptance.observe(accepted / drafted)
+
+    def record_verify_round(self, speculated: bool, emitted: int,
+                            draft_steps: int) -> None:
+        with self._lock:
+            self.verify_rounds += 1
+            if speculated:
+                self.spec_rounds += 1
+            self.emitted += emitted
+            self.draft_steps += draft_steps
+
+    def record_demotion(self, fault: bool = False) -> None:
+        with self._lock:
+            self.demotions += 1
+            if fault:
+                self.fault_demotions += 1
+
+    def record_reprobe(self) -> None:
+        with self._lock:
+            self.reprobes += 1
+
+    # -- reading -------------------------------------------------------- #
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "drafted": self.drafted,
+                "accepted": self.accepted,
+                "rolled_back": self.rolled_back,
+                "draft_steps": self.draft_steps,
+                "verify_rounds": self.verify_rounds,
+                "spec_rounds": self.spec_rounds,
+                "emitted": self.emitted,
+                "demotions": self.demotions,
+                "fault_demotions": self.fault_demotions,
+                "reprobes": self.reprobes,
+                "acceptance_rate":
+                    (self.accepted / self.drafted) if self.drafted else None,
+                "draft_overhead":
+                    (self.draft_steps / self.emitted)
+                    if self.emitted else None,
+                "acceptance": self.acceptance.snapshot(),
+            }

@@ -1,0 +1,191 @@
+"""Host-side acceptance for draft-verify speculative decoding.
+
+The verify executable returns one target-logits row per candidate
+position; this module decides, row by row, which token the stream
+actually emits.  Two modes:
+
+- ``"replay"`` (default, the bit-exact mode): every row emits the token
+  offline ``generate()`` would have picked — argmax for greedy, the
+  key-chain ``jax.random.categorical`` draw for sampled — and a draft
+  is "accepted" exactly when it equals that token.  The emitted stream
+  is therefore ALWAYS the offline trajectory, for greedy AND sampled
+  requests; speculation only changes how many of its tokens land per
+  device step.  Because jax's categorical is Gumbel-argmax, a drafter
+  that samples with the SAME chain keys is Gumbel-coupled to the
+  target, which is what makes sampled acceptance rates non-trivial.
+
+- ``"rejection"`` — classical speculative sampling (Leviathan et al.,
+  2023): accept draft ``d`` with probability ``min(1, p(d)/q(d))``,
+  else emit a draw from the normalized residual ``max(p - q, 0)``.
+  The per-token DISTRIBUTION is exactly the target's, but the realized
+  trajectory is not the offline key chain's, so this mode is excluded
+  from the bit-exact oracle (it is still fully deterministic for a
+  fixed seed: all auxiliary draws fold the chain key).
+
+Both modes share one control-flow invariant the engine relies on: the
+emitted token equals the draft IFF the draft was accepted (a rejection
+residual can never re-draw ``d``, since rejection implies
+``p(d) < q(d)`` and the residual mass at ``d`` is then zero), so the
+engine can walk rows left to right and stop at the first mismatch.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+#: fold_in tags deriving the rejection mode's auxiliary streams from the
+#: slot's chain key — draft draw, accept coin, residual draw.  Distinct
+#: odd constants so the three never alias each other or the chain key.
+FOLD_DRAFT = 101
+FOLD_ACCEPT = 103
+FOLD_RESIDUAL = 107
+
+SAMPLING_MODES = ("replay", "rejection")
+
+
+class SpecConfig:
+    """Speculation knobs for :class:`~bigdl_tpu.serving.LMServingEngine`.
+
+    Args:
+        k: draft tokens per verify round (static per engine — the verify
+            executable's candidate width is ``k + 1``).
+        draft: an optional built ``TransformerLM`` drafter.  Default
+            ``None`` derives one from the target: its int8
+            ``quantize()`` clone (or the target itself when the target
+            is already int8 — then drafting is memory-bandwidth-cheap
+            verification of the engine's own stream).
+        sampling: ``"replay"`` (bit-exact vs offline generate, the
+            default) or ``"rejection"`` (distribution-exact speculative
+            sampling).
+        ema_alpha: weight of the newest round in the per-slot
+            acceptance-rate EMA.
+        demote_below: demote a slot to plain decode when its EMA falls
+            below this after ``min_rounds`` speculated rounds.
+        min_rounds: rounds of evidence before demotion can trigger.
+        probe_interval: plain-decode rounds a demoted slot serves before
+            speculation is re-probed.
+    """
+
+    def __init__(self, k: int = 4, *, draft=None, sampling: str = "replay",
+                 ema_alpha: float = 0.3, demote_below: float = 0.1,
+                 min_rounds: int = 4, probe_interval: int = 8):
+        self.k = int(k)
+        if self.k < 1:
+            raise ValueError(f"spec k must be >= 1, got {k}")
+        if sampling not in SAMPLING_MODES:
+            raise ValueError(f"sampling must be one of {SAMPLING_MODES}, "
+                             f"got {sampling!r}")
+        self.draft = draft
+        self.sampling = sampling
+        self.ema_alpha = float(ema_alpha)
+        if not 0.0 < self.ema_alpha <= 1.0:
+            raise ValueError(f"ema_alpha must be in (0, 1], got {ema_alpha}")
+        self.demote_below = float(demote_below)
+        self.min_rounds = int(min_rounds)
+        if self.min_rounds < 1:
+            raise ValueError(f"min_rounds must be >= 1, got {min_rounds}")
+        self.probe_interval = int(probe_interval)
+        if self.probe_interval < 1:
+            raise ValueError(
+                f"probe_interval must be >= 1, got {probe_interval}")
+
+    def describe(self) -> dict:
+        return {"k": self.k, "sampling": self.sampling,
+                "ema_alpha": self.ema_alpha,
+                "demote_below": self.demote_below,
+                "min_rounds": self.min_rounds,
+                "probe_interval": self.probe_interval}
+
+
+def pick_token(logits_row: np.ndarray, temperature: float, key,
+               clamp: bool) -> int:
+    """The offline sampling rule for one logits row: argmax at
+    temperature 0 (or without a key), else the key-chain categorical
+    over (1, V) — shapes and clamping replicate ``generate()`` exactly,
+    which is what makes serving streams bit-exact against it."""
+    if temperature <= 0.0 or key is None:
+        return int(np.argmax(logits_row))
+    import jax
+    import jax.numpy as jnp
+    denom = max(temperature, 1e-6) if clamp else temperature
+    return int(jax.random.categorical(
+        jnp.asarray(key), jnp.asarray(logits_row)[None, :] / denom,
+        axis=-1)[0])
+
+
+def _softmax(logits: np.ndarray) -> np.ndarray:
+    z = logits.astype(np.float64) - float(np.max(logits))
+    e = np.exp(z)
+    return e / e.sum()
+
+
+def draft_pick(logits_row: np.ndarray, temperature: float, key,
+               mode: str) -> int:
+    """How the DRAFTER chooses its proposal.  Greedy without a key;
+    replay mode samples with the slot's OWN chain key (Gumbel-coupling
+    the draft to the target's draw); rejection mode draws from q with
+    an independent folded key, as the rejection identity requires."""
+    if temperature <= 0.0 or key is None:
+        return int(np.argmax(logits_row))
+    if mode == "rejection":
+        import jax
+        import jax.numpy as jnp
+        t = max(temperature, 1e-6)
+        return int(jax.random.categorical(
+            jax.random.fold_in(jnp.asarray(key), FOLD_DRAFT),
+            jnp.asarray(logits_row)[None, :] / t, axis=-1)[0])
+    return pick_token(logits_row, temperature, key, clamp=True)
+
+
+def accept_row(target_row: np.ndarray, draft_tok: Optional[int],
+               temperature: float, key, mode: str,
+               draft_row: Optional[np.ndarray] = None) -> int:
+    """Emit one token for one verify row.  ``draft_tok`` is None on the
+    bonus row (all drafts already accepted).  Returns the emitted
+    0-based token; it equals ``draft_tok`` iff the draft is accepted."""
+    if (draft_tok is None or mode != "rejection"
+            or temperature <= 0.0 or key is None):
+        return pick_token(target_row, temperature, key, clamp=True)
+    import jax
+    import jax.numpy as jnp
+    t = max(temperature, 1e-6)
+    p = _softmax(np.asarray(target_row) / t)
+    q = _softmax(np.asarray(draft_row) / t)
+    kj = jnp.asarray(key)
+    u = float(jax.random.uniform(jax.random.fold_in(kj, FOLD_ACCEPT)))
+    d = int(draft_tok)
+    if q[d] > 0.0 and u <= min(1.0, float(p[d] / q[d])):
+        return d
+    r = np.maximum(p - q, 0.0)
+    s = float(r.sum())
+    if s <= 0.0:
+        # p == q exactly: the residual is empty and acceptance was
+        # certain; numerically unreachable here but fall back to p
+        return pick_token(target_row, temperature, key, clamp=True)
+    logr = np.log(np.where(r > 0.0, r / s, 1e-300))
+    return int(jax.random.categorical(
+        jax.random.fold_in(kj, FOLD_RESIDUAL),
+        jnp.asarray(logr, dtype=np.float32)[None, :], axis=-1)[0])
+
+
+def accept_walk(target_rows: np.ndarray, drafts: Sequence[int],
+                temperature: float, keys, mode: str,
+                draft_rows=None) -> tuple:
+    """Pure acceptance walk (no engine state): emit rows left to right,
+    stopping after the first non-matching emission or the bonus row.
+    Returns (emitted 0-based tokens, n_accepted).  Exposed for tests;
+    the engine inlines the same walk to interleave EOS/budget checks."""
+    emitted: list = []
+    accepted = 0
+    k_eff = len(drafts)
+    for j in range(k_eff + 1):
+        key = keys[j] if keys is not None else None
+        e = accept_row(target_rows[j], drafts[j] if j < k_eff else None,
+                       temperature, key, mode,
+                       draft_rows[j] if draft_rows is not None else None)
+        emitted.append(e)
+        if j >= k_eff or drafts[j] != e:
+            break
+        accepted += 1
+    return emitted, accepted

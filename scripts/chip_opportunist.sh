@@ -59,6 +59,7 @@ if [ "$SMOKE" = "1" ]; then
   CONV_ARGS="--lenet-epochs 1 --lenet-records 256 --vgg-epochs 1 --vgg-records 128 --batch 32"
   SCAN_ITERS=1; SCAN_STEPS=2
   SERVE_LM_ARGS="--requests 6 --slots 2 --cache-len 64 --mean-gap-ms 5 --probes 1"
+  SPEC_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1"
   PREFIX_ARGS="--requests 6 --slots 2 --cache-len 96 --shared-len 32 --mean-gap-ms 5 --probes 1"
   SLO_ARGS="--loads 4,8 --duration 1.5 --chaos-duration 2 --chaos-rps 15 --slots 2 --cache-len 64"
   MESH_ARGS="--requests 8 --batch 4"
@@ -79,6 +80,7 @@ else
   CONV_ARGS=""
   SCAN_ITERS=3; SCAN_STEPS=8
   SERVE_LM_ARGS="--requests 48 --slots 8 --cache-len 128"
+  SPEC_ARGS="--requests 24 --slots 8 --cache-len 128"
   PREFIX_ARGS="--requests 24 --slots 8 --cache-len 128 --shared-len 64"
   SLO_ARGS="--loads 4,8,16,32,64 --duration 5 --chaos-duration 8"
   MESH_ARGS="--requests 48 --batch 8"
@@ -117,6 +119,7 @@ PYEOF
 ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json TUNE_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
 BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json BENCH_MESH.json \
+BENCH_SPEC.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
 SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
@@ -277,6 +280,27 @@ serve_lm_stage() {
   return 1
 }
 
+# spec rides right after serve-lm: same decode hot path plus the
+# draft-verify plane (int8 drafter decode + the one donated verify
+# executable), replaying the serve-lm trace through both a spec and a
+# plain engine.  Params stay ~1 MB so every transfer is far below the
+# 32 MB relay ceiling.  Same ok_lm gate — the repo ships a CPU-proven
+# BENCH_SPEC.json, which must never mark the TPU stage done — and the
+# same never-gates-the-round contract.
+spec_stage() {
+  ok_lm BENCH_SPEC.json && return 0
+  say "stage spec: firing (budget 600s): python -u bench.py --serve-lm --spec $SPEC_ARGS"
+  timeout 600 python -u bench.py --serve-lm --spec $SPEC_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm BENCH_SPEC.json; then
+    say "stage spec: DONE"
+    return 0
+  fi
+  say "stage spec: not done (rc=$rc)"
+  record_incident spec "$rc"
+  return 1
+}
+
 # mesh rides right after serve-lm: it proves the placement subsystem
 # against the REAL device set (TP-slot carving + sharded param staging
 # through the chunked relay discipline) — on a multi-chip window the
@@ -406,6 +430,7 @@ while :; do
       run_stage bench BENCH_LAST.json 420 python -u bench.py
     autotune_stage
     serve_lm_stage
+    spec_stage
     mesh_stage
     prefix_stage
     slo_stage

@@ -14,9 +14,12 @@ N=${N:-3}
 # the run in seconds, before the full shards spend their minutes.
 # test_kvcache.py carries the pool-exhaustion faults (typed rejection
 # vs deferral) — KV memory pressure is a first-class fault domain.
+# test_spec_decode.py carries the serving.verify site (a transient
+# demotes speculating slots instead of killing streams) and the
+# acceptance-collapse demotion matrix.
 if [ "${FAULTS_GATE:-1}" = "1" ]; then
   python -m pytest tests/test_resilience.py tests/test_traffic.py \
-    tests/test_kvcache.py -q -m faults || exit 1
+    tests/test_kvcache.py tests/test_spec_decode.py -q -m faults || exit 1
 fi
 
 # Artifact schema lint: committed BENCH_*/TUNE_*/PROFILE_* files are

@@ -60,6 +60,36 @@ def _mesh_problems(doc) -> list:
     return probs
 
 
+def _spec_problems(doc) -> list:
+    """BENCH_SPEC.json extras: the speculative-decoding proof is only
+    evidence if the spec stream IS the offline trajectory — a complete
+    doc must carry summary.agreement == 1.0 and a measured acceptance
+    rate in [0, 1]; any speedup number without those is noise."""
+    probs = []
+    if doc.get("error"):
+        return probs
+    for i, r in enumerate(doc.get("rows", [])):
+        if not isinstance(r, dict):
+            continue
+        if "stage" not in r:
+            probs.append("spec row %d lacks a 'stage' key" % i)
+    if doc.get("complete") is True:
+        summ = doc.get("summary")
+        if not isinstance(summ, dict):
+            probs.append("complete spec artifact lacks a summary")
+            return probs
+        if summ.get("agreement") != 1.0:
+            probs.append("complete spec artifact: summary.agreement "
+                         "must be exactly 1.0, got %r"
+                         % (summ.get("agreement"),))
+        a = summ.get("acceptance_rate")
+        if not isinstance(a, (int, float)) or not 0.0 <= a <= 1.0:
+            probs.append("complete spec artifact: "
+                         "summary.acceptance_rate must be a fraction "
+                         "in [0, 1], got %r" % (a,))
+    return probs
+
+
 def _problems(doc, name: str = "") -> list:
     """Contract violations for one parsed artifact document."""
     probs = []
@@ -87,6 +117,8 @@ def _problems(doc, name: str = "") -> list:
             probs.append("'%s' holds non-object entries" % section)
         if name == "BENCH_MESH.json":
             probs.extend(_mesh_problems(doc))
+        if name == "BENCH_SPEC.json":
+            probs.extend(_spec_problems(doc))
         return probs
     if "metric" not in doc:
         probs.append("no 'rows', no supervisor record, no 'metric' key "

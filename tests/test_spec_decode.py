@@ -1,0 +1,343 @@
+"""Speculative decoding: draft-verify subsystem on the slot engine.
+
+Tier-1 coverage of `bigdl_tpu.serving.spec`: bit-exactness of greedy
+AND sampled speculative streams vs offline ``generate`` (key-chain
+replay acceptance) — including with radix sharing on and an int8
+target clone — the exactly-one-verify-executable contract (same
+discipline as decode), deterministic acceptance-collapse demotion and
+re-probe, the ``serving.verify`` fault site (an injected transient
+demotes speculating slots instead of killing streams), metrics
+exposure, and the budget/EOS boundary behavior of the accept walk.
+"""
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.models.transformer import TransformerLM
+from bigdl_tpu.models.transformer.generate import generate
+from bigdl_tpu.obs import get_registry
+from bigdl_tpu.serving import LMServingEngine, SpecConfig
+from bigdl_tpu.serving.spec import accept_walk
+
+
+def _wait(pred, timeout=30.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        if pred():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _lm(vocab=31, hidden=16, heads=2, layers=1, max_len=64, seed=0,
+        pos="rope"):
+    return TransformerLM(vocab_size=vocab, hidden_size=hidden,
+                         n_head=heads, n_layers=layers, max_len=max_len,
+                         pos_encoding=pos).build(seed=seed)
+
+
+def _ref(model, prompt, max_new, temperature=0.0, seed=None):
+    kw = dict(temperature=temperature)
+    if seed is not None:
+        import jax
+        kw["rng"] = jax.random.PRNGKey(seed)
+    return np.asarray(generate(model, model.params,
+                               np.asarray(prompt)[None].astype(np.int32),
+                               max_new, **kw))[0]
+
+
+@pytest.fixture(scope="module")
+def lm_model():
+    return _lm()
+
+
+@pytest.fixture(scope="module")
+def spec_engine(lm_model):
+    """One shared spec engine (f32 target, default int8 drafter) for
+    the read-only fast tests — every engine compiles prefill + verify +
+    drafter programs, so sharing keeps tier-1 inside budget."""
+    eng = LMServingEngine(lm_model, slots=4, cache_len=48, block_len=4,
+                          max_new_tokens=12, prefill_buckets=(8, 16),
+                          spec=SpecConfig(k=3))
+    eng.warmup()
+    yield eng
+    eng.close()
+
+
+# --------------------------------------------------------------------------- #
+# config validation                                                           #
+# --------------------------------------------------------------------------- #
+
+def test_spec_config_validation():
+    with pytest.raises(ValueError):
+        SpecConfig(k=0)
+    with pytest.raises(ValueError):
+        SpecConfig(sampling="nucleus")
+    with pytest.raises(ValueError):
+        SpecConfig(ema_alpha=0.0)
+    with pytest.raises(ValueError):
+        SpecConfig(min_rounds=0)
+    with pytest.raises(ValueError):
+        SpecConfig(probe_interval=0)
+    assert SpecConfig(k=4).describe()["sampling"] == "replay"
+
+
+def test_spec_vocab_mismatch_rejected(lm_model):
+    other = _lm(vocab=17)
+    with pytest.raises(ValueError, match="vocab"):
+        LMServingEngine(lm_model, slots=1, cache_len=32,
+                        spec=SpecConfig(k=2, draft=other))
+
+
+# --------------------------------------------------------------------------- #
+# bit-exactness vs offline generate                                           #
+# --------------------------------------------------------------------------- #
+
+def test_spec_greedy_exact_vs_offline(spec_engine, lm_model):
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 32, size=n).astype(np.int32)
+               for n in (5, 9, 14)]
+    streams = [spec_engine.submit(p, max_new_tokens=12) for p in prompts]
+    for p, s in zip(prompts, streams):
+        np.testing.assert_array_equal(s.result(timeout=60),
+                                      _ref(lm_model, p, 12))
+    spec = spec_engine.stats()["spec"]
+    assert spec["drafted"] > 0
+    assert spec["acceptance_rate"] > 0.0
+
+
+def test_spec_sampled_exact_vs_offline(spec_engine, lm_model):
+    rng = np.random.default_rng(1)
+    cases = [(rng.integers(1, 32, size=n).astype(np.int32), t, s)
+             for (n, t, s) in ((6, 0.7, 3), (11, 1.3, 4))]
+    streams = [spec_engine.submit(p, max_new_tokens=12, temperature=t,
+                                  rng=s) for p, t, s in cases]
+    for (p, t, s), stm in zip(cases, streams):
+        np.testing.assert_array_equal(
+            stm.result(timeout=60), _ref(lm_model, p, 12, t, s))
+
+
+def test_spec_int8_target_with_radix_sharing(lm_model):
+    """The acceptance criterion's hardest combination: the TARGET is an
+    int8 quantize() clone, radix prefix sharing is on (same prompt
+    served twice, greedy and sampled), and every stream must still be
+    the offline trajectory bit-exact."""
+    qlm = lm_model.quantize("int8")
+    eng = LMServingEngine(qlm, slots=4, cache_len=48, block_len=4,
+                          max_new_tokens=8, prefill_buckets=(8, 16),
+                          spec=SpecConfig(k=3))
+    eng.warmup()
+    try:
+        rng = np.random.default_rng(2)
+        base = rng.integers(1, 32, size=8).astype(np.int32)
+        cases = [(base, 0.0, None), (base.copy(), 0.7, 3),
+                 (np.concatenate([base, [5, 7]]).astype(np.int32),
+                  0.9, 4)]
+        streams = [eng.submit(p, max_new_tokens=8, temperature=t,
+                              rng=s) for p, t, s in cases]
+        for (p, t, s), stm in zip(cases, streams):
+            np.testing.assert_array_equal(
+                stm.result(timeout=60), _ref(qlm, p, 8, t, s))
+        assert eng.radix.hit_rate() > 0.0
+        assert eng.stats()["spec"]["drafted"] > 0
+        # int8 target -> the default drafter is the target itself
+        assert eng.draft.model is qlm
+    finally:
+        eng.close()
+
+
+def test_spec_eos_mid_window_truncates_exactly(spec_engine, lm_model):
+    p = np.asarray([3, 9, 14, 2, 6], np.int32)
+    ref = _ref(lm_model, p, 12)
+    gen = ref[len(p):]
+    eos = int(gen[min(3, len(gen) - 1)])
+    first_hit = int(np.argmax(gen == eos))
+    out = spec_engine.submit(p, max_new_tokens=12,
+                             eos_id=eos).result(timeout=60)
+    np.testing.assert_array_equal(out, ref[:len(p) + first_hit + 1])
+    assert out[-1] == eos
+
+
+def test_spec_budget_boundaries(spec_engine, lm_model):
+    """k_eff clamps to the remaining budget: max_new=1 finishes at
+    prefill (the drafter never engages), max_new=2 leaves room for zero
+    drafts (a pure plain round) — both must stay exact and never write
+    past the allocated chain."""
+    p = np.asarray([7, 1, 22], np.int32)
+    for m in (1, 2, 5):
+        np.testing.assert_array_equal(
+            spec_engine.submit(p, max_new_tokens=m).result(timeout=60),
+            _ref(lm_model, p, m))
+
+
+def test_spec_long_prompt_serves_plain(spec_engine, lm_model):
+    """Chunk-admitted prompts (longer than the largest prefill bucket,
+    16 on this engine) skip speculation but still serve, exact."""
+    before = spec_engine.stats()["spec"]["drafted"]
+    p = np.arange(1, 21).astype(np.int32)  # 20 > largest bucket 16
+    np.testing.assert_array_equal(
+        spec_engine.submit(p, max_new_tokens=6).result(timeout=60),
+        _ref(lm_model, p, 6))
+    assert spec_engine.stats()["spec"]["drafted"] == before  # no drafts
+
+
+# --------------------------------------------------------------------------- #
+# the exactly-one-executable contract + donation                              #
+# --------------------------------------------------------------------------- #
+
+def test_one_verify_executable_and_donation(spec_engine):
+    """After mixed lengths, temperatures, EOS exits and slot churn, the
+    engine holds exactly ONE verify executable and ONE drafter decode
+    executable (the same contract as plain decode), and the donated
+    arenas kept their buffers (no realloc per round)."""
+    ptrs = spec_engine.cache_buffer_pointers()
+    p = np.asarray([2, 4, 8], np.int32)
+    spec_engine.submit(p, max_new_tokens=8).result(timeout=60)
+    assert spec_engine._verify_compiles == 1
+    assert spec_engine.draft.decode_compiles == 1
+    assert spec_engine.cache_buffer_pointers() == ptrs
+
+
+# --------------------------------------------------------------------------- #
+# acceptance-collapse demotion / re-probe                                     #
+# --------------------------------------------------------------------------- #
+
+def _zero_drafter(vocab=31):
+    """A drafter that provably disagrees: all-zero params make every
+    logits row constant, so it always drafts token 0 (1-based id 1)."""
+    import jax
+    import jax.numpy as jnp
+    bad = _lm(vocab=vocab, seed=1)
+    bad.params = jax.tree_util.tree_map(jnp.zeros_like, bad.params)
+    return bad
+
+
+@pytest.mark.faults
+def test_acceptance_collapse_demotes_and_reprobes(lm_model):
+    """Deterministic collapse: the zero drafter never matches (the
+    reference stream emits no 1s), so the EMA falls below the threshold
+    after min_rounds, the slot demotes to plain decode, re-probes after
+    probe_interval rounds, collapses again — and the stream stays the
+    offline trajectory throughout."""
+    p = np.asarray([8, 10, 27, 14, 9, 26], np.int32)
+    ref = _ref(lm_model, p, 24)
+    assert 1 not in ref[len(p):]  # the premise of determinism
+    eng = LMServingEngine(lm_model, slots=1, cache_len=48, block_len=4,
+                          max_new_tokens=24, prefill_buckets=(8,),
+                          spec=SpecConfig(k=3, draft=_zero_drafter(),
+                                          ema_alpha=0.5, demote_below=0.5,
+                                          min_rounds=2, probe_interval=3))
+    eng.warmup()
+    try:
+        out = eng.submit(p, max_new_tokens=24).result(timeout=60)
+        np.testing.assert_array_equal(out, ref)
+        spec = eng.stats()["spec"]
+        assert spec["acceptance_rate"] == 0.0
+        assert spec["demotions"] >= 2   # collapsed, re-probed, collapsed
+        assert spec["reprobes"] >= 1
+        assert spec["rolled_back"] == spec["drafted"] > 0
+    finally:
+        eng.close()
+
+
+# --------------------------------------------------------------------------- #
+# the serving.verify fault site                                               #
+# --------------------------------------------------------------------------- #
+
+@pytest.mark.faults
+def test_verify_fault_demotes_not_kills(lm_model, monkeypatch):
+    """An injected transient during a verify step demotes the
+    speculating slots to plain decode (typed, counted) — the stream
+    completes bit-exact instead of erroring."""
+    from bigdl_tpu.resilience import faults
+    monkeypatch.setenv(faults.ENV_SPEC, "serving.verify:transient:count=1")
+    faults.refresh_from_env()
+    try:
+        before = (get_registry().snapshot()
+                  .get("resilience/faults_injected", {}).get("value")
+                  or 0)
+        eng = LMServingEngine(lm_model, slots=2, cache_len=48,
+                              block_len=4, max_new_tokens=16,
+                              prefill_buckets=(8,),
+                              spec=SpecConfig(k=3, probe_interval=2))
+        eng.warmup()
+        try:
+            p = np.arange(1, 7).astype(np.int32)
+            out = eng.submit(p, max_new_tokens=16).result(timeout=60)
+            np.testing.assert_array_equal(out, _ref(lm_model, p, 16))
+            spec = eng.stats()["spec"]
+            assert spec["fault_demotions"] == 1
+            assert spec["reprobes"] >= 1  # came back after the transient
+            snap = get_registry().snapshot()
+            assert snap["resilience/faults_injected"]["value"] == before + 1
+            assert snap["serving/lm/spec/fault_demotions"]["value"] == 1
+        finally:
+            eng.close()
+    finally:
+        monkeypatch.delenv(faults.ENV_SPEC, raising=False)
+        faults.refresh_from_env()
+
+
+# --------------------------------------------------------------------------- #
+# rejection sampling mode                                                     #
+# --------------------------------------------------------------------------- #
+
+def test_rejection_mode_deterministic_and_greedy_exact(lm_model):
+    """``sampling="rejection"`` is distribution-exact, not
+    trajectory-exact: sampled streams need not match offline generate,
+    but they must be fully deterministic for a fixed seed — and greedy
+    degenerates to the replay walk, which IS exact."""
+    eng = LMServingEngine(lm_model, slots=2, cache_len=48, block_len=4,
+                          max_new_tokens=12, prefill_buckets=(8,),
+                          spec=SpecConfig(k=2, sampling="rejection"))
+    eng.warmup()
+    try:
+        p = np.asarray([4, 19, 2, 30], np.int32)
+        a = eng.submit(p, max_new_tokens=12, temperature=0.8,
+                       rng=7).result(timeout=60)
+        b = eng.submit(p, max_new_tokens=12, temperature=0.8,
+                       rng=7).result(timeout=60)
+        np.testing.assert_array_equal(a, b)
+        np.testing.assert_array_equal(
+            eng.submit(p, max_new_tokens=12).result(timeout=60),
+            _ref(lm_model, p, 12))
+        assert eng.stats()["spec"]["drafted"] > 0
+    finally:
+        eng.close()
+
+
+def test_accept_walk_unit():
+    """The pure acceptance walk: replay mode accepts exactly the
+    matching prefix and emits the target token at the first mismatch."""
+    v = 8
+    rows = np.full((4, v), -10.0, np.float32)
+    rows[0, 2] = rows[1, 5] = rows[2, 1] = rows[3, 7] = 10.0
+    # target picks: 2, 5, 1, 7
+    emitted, acc = accept_walk(rows, [2, 5, 4], 0.0, None, "replay")
+    assert emitted == [2, 5, 1] and acc == 2   # mismatch at draft 4
+    emitted, acc = accept_walk(rows, [2, 5, 1], 0.0, None, "replay")
+    assert emitted == [2, 5, 1, 7] and acc == 3  # full accept + bonus
+    emitted, acc = accept_walk(rows, [0, 5, 1], 0.0, None, "replay")
+    assert emitted == [2] and acc == 0
+
+
+# --------------------------------------------------------------------------- #
+# metrics exposure                                                            #
+# --------------------------------------------------------------------------- #
+
+def test_spec_metrics_published(spec_engine):
+    snap = get_registry().snapshot()
+    for key in ("accept_rate", "draft_overhead", "drafted", "accepted",
+                "rolled_back", "demotions", "fault_demotions"):
+        assert ("serving/lm/spec/" + key) in snap
+    assert snap["serving/lm/spec/drafted"]["value"] > 0
+    # LMMetrics carries the spec block next to slot occupancy
+    m = spec_engine.metrics.snapshot()
+    assert m["spec"] is not None
+    assert m["spec"]["acceptance_rate"] is not None
+    assert m["slot_occupancy"] is not None
+    st = spec_engine.stats()["spec"]
+    assert st["k"] == 3 and st["sampling"] == "replay"
+    assert st["draft"]["dtype_tag"] == "int8"
+    assert st["draft_overhead"] is not None
