@@ -1283,6 +1283,42 @@ def _serve_lm_bench(argv) -> int:
                           max_queue=max(args.requests, 256),
                           decode_attn="gather")
 
+    def _traced_stage():
+        """Same trace through a fresh engine with request tracing at
+        sample rate 1.0 AND the telemetry sampler running — tokens/s
+        here vs the plain continuous row prices the observability
+        layer (the acceptance bar is <= 3% overhead)."""
+        from bigdl_tpu.obs import TimeSeriesSampler, set_sampler
+        tr = get_tracer()
+        was_enabled, was_rate = tr.enabled, tr.sample_rate
+        tr.enable()
+        tr.set_sample_rate(1.0)
+        sampler = TimeSeriesSampler(interval_s=0.25, capacity=2400)
+        prev_sampler = set_sampler(sampler)
+        eng3 = LMServingEngine(model, slots=args.slots,
+                               cache_len=args.cache_len,
+                               block_len=args.block_len,
+                               max_queue=max(args.requests, 256),
+                               decode_attn="gather",
+                               name="lm-traced")
+        try:
+            eng3.warmup()
+            sampler.start()
+            row = _serve_lm_stage_continuous(eng3, model, work,
+                                             args.probes)
+            row["trace_sample_rate"] = 1.0
+            row["timeseries_rows"] = len(sampler)
+            row["request_span_trees"] = sum(
+                1 for ev in tr.events()
+                if ev.get("name") == "lm/request" and ev.get("ph") == "X")
+            return row
+        finally:
+            sampler.stop()
+            set_sampler(prev_sampler)
+            eng3.close()
+            tr.set_sample_rate(was_rate)
+            tr.enabled = was_enabled
+
     def _paged_kernel_stage():
         """Same trace through a second engine whose decode attention is
         the Pallas paged kernel (in-place block-table reads instead of
@@ -1314,6 +1350,7 @@ def _serve_lm_bench(argv) -> int:
             "continuous": lambda: _serve_lm_stage_continuous(
                 eng, model, work, args.probes),
             "continuous_paged_kernel": _paged_kernel_stage,
+            "continuous_traced": _traced_stage,
             "static_baseline": lambda: _serve_lm_stage_static(model, work),
         }
         for name, run in stages.items():
@@ -1330,8 +1367,12 @@ def _serve_lm_bench(argv) -> int:
         cont = next(r for r in rows if r.get("stage") == "continuous")
         paged = next(r for r in rows
                      if r.get("stage") == "continuous_paged_kernel")
+        traced = next(r for r in rows
+                      if r.get("stage") == "continuous_traced")
         stat = next(r for r in rows
                     if r.get("stage") == "static_baseline")
+        trace_ratio = (traced["tokens_per_s"] / cont["tokens_per_s"]
+                       if cont["tokens_per_s"] else None)
         speedup = (cont["tokens_per_s"] / stat["tokens_per_s"]
                    if stat["tokens_per_s"] else None)
         kern_speedup = (paged["tokens_per_s"] / cont["tokens_per_s"]
@@ -1350,6 +1391,14 @@ def _serve_lm_bench(argv) -> int:
             "paged_kernel_vs_gather": (round(kern_speedup, 3)
                                        if kern_speedup is not None
                                        else None),
+            "traced_tokens_per_s": traced["tokens_per_s"],
+            "tracing_overhead_ratio": (round(trace_ratio, 4)
+                                       if trace_ratio is not None
+                                       else None),
+            "tracing_within_3pct": (bool(trace_ratio >= 0.97)
+                                    if trace_ratio is not None
+                                    else None),
+            "request_span_trees": traced.get("request_span_trees"),
             "static_tokens_per_s": stat["tokens_per_s"],
             "static_ttft_p50_ms": stat["ttft"]["p50_ms"],
             "continuous_speedup": (round(speedup, 3)

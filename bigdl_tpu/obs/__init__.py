@@ -1,16 +1,33 @@
-"""bigdl_tpu.obs — unified observability: tracing, metrics, watchdog.
+"""bigdl_tpu.obs — unified observability: tracing, telemetry, forensics.
 
-Three pieces, one spine:
+Five pieces, one spine:
 
 - :mod:`~bigdl_tpu.obs.tracer` — thread-safe span API (context manager
   + decorator) over a ring buffer, exported as Chrome trace-event JSON
-  (Perfetto-loadable) or a structured JSONL log.  Enabled via
-  ``BIGDL_TPU_TRACE=1``; near-zero overhead when off.
+  (Perfetto-loadable) or a structured JSONL log.  Request-scoped:
+  every serving submission is minted a ``request_id``
+  (:func:`mint_request_id`), propagated through batch assembly,
+  prefill, decode/verify rounds, and failover re-dispatch, and
+  assembled back into a per-request span tree
+  (:meth:`Tracer.span_tree` / :meth:`Tracer.export_request`).
+  Enabled via ``BIGDL_TPU_TRACE=1``; sampled per request via
+  ``BIGDL_TPU_TRACE_SAMPLE``; near-zero overhead when off.
 - :mod:`~bigdl_tpu.obs.registry` — process-wide MetricRegistry of
-  counters/gauges/histograms; ``optim.Metrics`` and
+  counters/gauges/histograms (cardinality-capped;
+  ``BIGDL_TPU_REGISTRY_MAX``); ``optim.Metrics`` and
   ``serving.ServingMetrics`` publish into it, and one
   ``export_to_summary`` path writes everything through the
   ``visualization`` tfevents writers.
+- :mod:`~bigdl_tpu.obs.timeseries` — TimeSeriesSampler: a background
+  thread snapshotting the registry at a fixed interval into bounded
+  rings — gauge values, counter deltas, windowed histogram p50/p99 —
+  the time axis the SLO controller, bench.py, and post-mortems read.
+- :mod:`~bigdl_tpu.obs.flight` — FlightRecorder: on a watchdog stall,
+  a classified backend-lost, a fault-injector fire, or a shed burst,
+  atomically dump ONE correlated bundle (last spans + time-series
+  window + ``Engine.diagnose_tpu()`` + serving state + active request
+  ids) to ``FLIGHT_<ts>.json`` and append a pointer into
+  ``TUNNEL_INCIDENTS.json``.  Armed via ``BIGDL_TPU_FLIGHT=1``.
 - :mod:`~bigdl_tpu.obs.watchdog` — StallWatchdog: rolling-median step
   cadence; a hung step captures ``Engine.diagnose_tpu()`` + all-thread
   stacks into the trace before the process looks merely "slow".
@@ -29,18 +46,28 @@ Quickstart::
     reg.counter("app/requests").add(1)
     print(reg.snapshot())
 """
+from bigdl_tpu.obs.flight import (FlightRecorder, get_flight_recorder,
+                                  note_shed)
 from bigdl_tpu.obs.registry import (Counter, FnGauge, Gauge, Histogram,
                                     MetricRegistry, get_registry,
                                     percentile_from_counts)
-from bigdl_tpu.obs.tracer import Tracer, get_tracer
+from bigdl_tpu.obs.timeseries import (TimeSeriesSampler, get_sampler,
+                                      set_sampler)
+from bigdl_tpu.obs.tracer import (Tracer, get_tracer, mint_request_id,
+                                  set_request_context,
+                                  get_request_context,
+                                  clear_request_context)
 from bigdl_tpu.obs.watchdog import (StallWatchdog, env_watchdog_enabled,
                                     env_watchdog_kwargs, shared_watchdog,
                                     thread_stacks)
 
 __all__ = [
-    "Tracer", "get_tracer",
+    "Tracer", "get_tracer", "mint_request_id",
+    "set_request_context", "get_request_context", "clear_request_context",
     "Counter", "Gauge", "FnGauge", "Histogram", "MetricRegistry",
     "get_registry", "percentile_from_counts",
+    "TimeSeriesSampler", "get_sampler", "set_sampler",
+    "FlightRecorder", "get_flight_recorder", "note_shed",
     "StallWatchdog", "env_watchdog_enabled", "env_watchdog_kwargs",
     "shared_watchdog", "thread_stacks",
 ]

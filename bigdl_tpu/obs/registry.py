@@ -23,8 +23,12 @@ object the hot path mutates.
 from __future__ import annotations
 
 import bisect
+import logging
+import os
 import threading
 from typing import Callable, Dict, List, Optional
+
+log = logging.getLogger("bigdl_tpu.obs.registry")
 
 
 def _log_edges() -> List[float]:
@@ -41,23 +45,43 @@ def _log_edges() -> List[float]:
 _EDGES = _log_edges()
 
 
-def percentile_from_counts(counts, p: float) -> Optional[float]:
+#: what an overflow-bucket rank reports: the next geometric edge past
+#: the instrumented range (~100s) — finite and JSON-safe, but strictly
+#: greater than every in-range answer, so overflow mass can never make
+#: a window look *healthier* than the instrumented buckets would
+OVERFLOW_EDGE = _EDGES[-1] * 1.07
+
+
+def percentile_from_counts(counts, p: float,
+                           overflow: Optional[float] = None
+                           ) -> Optional[float]:
     """Percentile over a raw bucket-count vector shaped like
     ``Histogram.counts()`` (upper bucket edge, same conservative
     estimate as ``Histogram.percentile``).  The windowed-p99 primitive:
     subtracting two ``counts()`` snapshots gives the histogram of just
     the interval between them — how the SLO controller reads a sliding
-    p99 out of the lifetime histograms the engines publish."""
+    p99 out of the lifetime histograms the engines publish.
+
+    Edge cases, pinned by tests: an empty window is ``None`` (never
+    0.0); negative entries — a torn counts delta under concurrent
+    ``observe`` — are clamped to zero instead of corrupting the rank;
+    and a rank landing in the *overflow* bucket (observations past the
+    last edge) reports ``overflow`` (default :data:`OVERFLOW_EDGE`,
+    > every real edge) rather than the old quietly-too-small last
+    edge, which could read a stalled window as within SLO."""
+    counts = [c if c > 0 else 0 for c in counts]
     total = sum(counts)
     if not total:
         return None
+    if overflow is None:
+        overflow = OVERFLOW_EDGE
     rank = max(1, int(round(total * p / 100.0)))
     seen = 0
     for i, c in enumerate(counts):
         seen += c
         if seen >= rank:
-            return _EDGES[i] if i < len(_EDGES) else _EDGES[-1]
-    return _EDGES[-1]
+            return _EDGES[i] if i < len(_EDGES) else overflow
+    return overflow
 
 
 class Counter:
@@ -185,18 +209,52 @@ class MetricRegistry:
     Anything with a ``snapshot() -> dict`` method can be registered, so
     live ``Histogram``s owned by a serving engine and ``Counter``s owned
     by an optimizer coexist under one namespace.
+
+    Cardinality is bounded: dynamic name families (per-quant-path
+    gauges, anything keyed per request or per slot) would otherwise
+    grow the map for the life of the process.  Past ``max_metrics``
+    (env ``BIGDL_TPU_REGISTRY_MAX``) a *new* name gets a live but
+    detached metric — the caller's hot path keeps working, the map
+    stops growing — and the drop is self-reporting: every ``snapshot``
+    carries synthetic ``obs/registry_cardinality`` /
+    ``obs/registry_overflow_total`` gauges (synthetic so they never
+    perturb ``names()`` or collide with user names).
     """
 
-    def __init__(self):
+    DEFAULT_MAX_METRICS = 4096
+
+    def __init__(self, max_metrics: Optional[int] = None):
+        if max_metrics is None:
+            try:
+                max_metrics = int(os.environ.get(
+                    "BIGDL_TPU_REGISTRY_MAX", self.DEFAULT_MAX_METRICS))
+            except ValueError:
+                max_metrics = self.DEFAULT_MAX_METRICS
+        self.max_metrics = max(int(max_metrics), 8)
         self._metrics: Dict[str, object] = {}
+        self._overflow = 0
+        self._warned_overflow = False
         self._lock = threading.Lock()
+
+    def _overflowed(self, name: str) -> None:
+        # caller holds self._lock
+        self._overflow += 1
+        if not self._warned_overflow:
+            self._warned_overflow = True
+            log.warning(
+                "metric registry at cardinality cap (%d): %r and "
+                "subsequent new names get detached metrics; see "
+                "obs/registry_overflow_total", self.max_metrics, name)
 
     def _get_or_create(self, name: str, cls, **kw):
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = cls(**kw)
-                self._metrics[name] = m
+                if len(self._metrics) >= self.max_metrics:
+                    self._overflowed(name)
+                else:
+                    self._metrics[name] = m
             elif not isinstance(m, cls):
                 raise TypeError(
                     f"metric {name!r} already registered as "
@@ -221,7 +279,11 @@ class MetricRegistry:
         with self._lock:
             if not replace and name in self._metrics:
                 raise ValueError(f"metric {name!r} already registered")
-            self._metrics[name] = metric
+            if name not in self._metrics \
+                    and len(self._metrics) >= self.max_metrics:
+                self._overflowed(name)
+            else:
+                self._metrics[name] = metric
         return metric
 
     def unregister(self, name: str) -> None:
@@ -239,12 +301,29 @@ class MetricRegistry:
     def clear(self) -> None:
         with self._lock:
             self._metrics.clear()
+            self._overflow = 0
+            self._warned_overflow = False
+
+    def cardinality(self) -> int:
+        with self._lock:
+            return len(self._metrics)
+
+    def overflow_total(self) -> int:
+        """Metric creations refused (detached) by the cardinality cap."""
+        with self._lock:
+            return self._overflow
 
     def snapshot(self) -> dict:
-        """{name: metric.snapshot()} for every registered metric."""
+        """{name: metric.snapshot()} for every registered metric, plus
+        the synthetic self-reporting gauges ``obs/registry_cardinality``
+        and ``obs/registry_overflow_total``."""
         with self._lock:
             items = list(self._metrics.items())
-        return {name: m.snapshot() for name, m in items}
+            card, over = len(self._metrics), self._overflow
+        snap = {name: m.snapshot() for name, m in items}
+        snap["obs/registry_cardinality"] = {"value": float(card)}
+        snap["obs/registry_overflow_total"] = {"value": float(over)}
+        return snap
 
     def export_to_summary(self, summary, step: int,
                           prefix: str = "Obs/") -> int:

@@ -25,13 +25,25 @@ process-wide tracer; ``enable()``/``disable()`` flip it at runtime).
 Timestamps are ``time.perf_counter`` microseconds relative to the
 tracer's epoch — monotonic, immune to NTP steps, and exactly what the
 Chrome ``ts``/``dur`` fields want.
+
+Request-scoped tracing rides the same buffer: serving entry points mint
+an id with :func:`mint_request_id`, stamp it into span ``args``
+(``request_id`` for per-request events, ``request_ids`` for batch-level
+events that cover several), and :meth:`Tracer.span_tree` /
+:meth:`Tracer.export_request` reassemble one request's timeline from
+the ring.  ``BIGDL_TPU_TRACE_SAMPLE`` (0..1, default 1) decides — by a
+deterministic hash of the id, so every layer agrees without passing a
+flag — which requests record their per-round events, keeping tracing
+cheap at high QPS.
 """
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
 import time
+import zlib
 from collections import deque
 from functools import wraps
 from typing import Optional
@@ -39,6 +51,50 @@ from typing import Optional
 
 def _env_enabled() -> bool:
     return os.environ.get("BIGDL_TPU_TRACE", "0").lower() in ("1", "true", "on")
+
+
+def _env_sample_rate() -> float:
+    try:
+        rate = float(os.environ.get("BIGDL_TPU_TRACE_SAMPLE", "1"))
+    except ValueError:
+        return 1.0
+    return min(max(rate, 0.0), 1.0)
+
+
+#: process-wide request-id sequence; ids stay unique across engines and
+#: batchers inside one process, and the pid prefix disambiguates merged
+#: multi-process traces
+_REQ_SEQ = itertools.count(1)
+
+
+def mint_request_id() -> str:
+    """A fresh request id (``r<pid>-<seq>``).  Always cheap, always
+    minted — the flight recorder lists active ids even when tracing is
+    off; sampling only gates what the *tracer* records for the id."""
+    return "r%d-%d" % (os.getpid(), next(_REQ_SEQ))
+
+
+# -- request context ---------------------------------------------------- #
+# The batcher knows which requests are in the batch it is dispatching;
+# the layers below it (ReplicaSet failover, engine run_batch) only see a
+# padded array.  A thread-local carries the ids across that call so the
+# failover hop can stamp them without widening every run_batch signature.
+_REQCTX = threading.local()
+
+
+def set_request_context(request_ids) -> None:
+    """Bind the given request ids to the current thread (the dispatch
+    thread) until cleared; tuple-copied so callers can reuse the list."""
+    _REQCTX.rids = tuple(request_ids)
+
+
+def get_request_context() -> tuple:
+    """Request ids bound to the current thread (empty when none)."""
+    return getattr(_REQCTX, "rids", ())
+
+
+def clear_request_context() -> None:
+    _REQCTX.rids = ()
 
 
 class _NullSpan:
@@ -88,8 +144,11 @@ class Tracer:
     """
 
     def __init__(self, capacity: int = 65536,
-                 enabled: Optional[bool] = None):
+                 enabled: Optional[bool] = None,
+                 sample_rate: Optional[float] = None):
         self.enabled = _env_enabled() if enabled is None else bool(enabled)
+        self.sample_rate = (_env_sample_rate() if sample_rate is None
+                            else min(max(float(sample_rate), 0.0), 1.0))
         self._events: deque = deque(maxlen=int(capacity))
         self._lock = threading.Lock()
         # perf_counter epoch; the unix pair stamps exports with wall time
@@ -103,6 +162,25 @@ class Tracer:
 
     def disable(self) -> None:
         self.enabled = False
+
+    def set_sample_rate(self, rate: float) -> None:
+        self.sample_rate = min(max(float(rate), 0.0), 1.0)
+
+    def sampled(self, request_id: Optional[str]) -> bool:
+        """Whether per-round events should be recorded for this request.
+
+        Deterministic on the id (crc32 fraction vs ``sample_rate``), so
+        admission, prefill, decode and failover all make the same call
+        without coordinating — a sampled request traces end to end, an
+        unsampled one costs nothing anywhere."""
+        if not self.enabled or not request_id:
+            return False
+        if self.sample_rate >= 1.0:
+            return True
+        if self.sample_rate <= 0.0:
+            return False
+        frac = (zlib.crc32(request_id.encode()) & 0xFFFFFFFF) / 2.0 ** 32
+        return frac < self.sample_rate
 
     def clear(self) -> None:
         with self._lock:
@@ -168,8 +246,91 @@ class Tracer:
 
     # -- reading / export ---------------------------------------------- #
     def events(self) -> list:
+        """A snapshot of the ring, ordered by start timestamp.
+
+        Events land in the ring at *completion* time, so under
+        concurrent writers the raw append order interleaves
+        arbitrarily; sorting by ``ts`` (stable, so equal-ts events keep
+        completion order) gives every reader — exports, the flight
+        recorder, tests — one canonical ordering.  Each event dict is
+        copied under the lock, so a reader never sees a span another
+        thread is still assembling."""
         with self._lock:
-            return [dict(e) for e in self._events]
+            evs = [dict(e) for e in self._events]
+        evs.sort(key=lambda e: e.get("ts", 0.0))
+        return evs
+
+    @staticmethod
+    def _matches_request(ev: dict, request_id: str) -> bool:
+        args = ev.get("args")
+        if not isinstance(args, dict):
+            return False
+        if args.get("request_id") == request_id:
+            return True
+        rids = args.get("request_ids")
+        return isinstance(rids, (list, tuple)) and request_id in rids
+
+    def request_events(self, request_id: str) -> list:
+        """Every buffered event stamped with this request id — directly
+        (``args.request_id``) or as a member of a batch-level event's
+        ``args.request_ids`` list."""
+        return [e for e in self.events()
+                if self._matches_request(e, request_id)]
+
+    def span_tree(self, request_id: str) -> dict:
+        """One request's events assembled into a phase tree.
+
+        Spans nest by interval containment (a span whose ``[ts,
+        ts+dur]`` lies inside another's is its child), which
+        reconstructs the request's lifecycle — queue wait, prefill
+        chunks, per-round decode/verify, failover hops — from the flat
+        ring without the recorders ever coordinating.  Instants join as
+        zero-duration leaves.  Returns ``{"request_id", "span_count",
+        "spans": [...]}`` where each span is ``{"name", "cat", "ph",
+        "ts", "dur", "args", "children"}``."""
+        nodes = []
+        for e in sorted(self.request_events(request_id),
+                        key=lambda e: (e.get("ts", 0.0),
+                                       -e.get("dur", 0.0))):
+            nodes.append({"name": e.get("name"), "cat": e.get("cat"),
+                          "ph": e.get("ph"), "ts": e.get("ts", 0.0),
+                          "dur": e.get("dur", 0.0),
+                          "args": e.get("args", {}), "children": []})
+        roots: list = []
+        stack: list = []
+        for n in nodes:
+            end = n["ts"] + n["dur"]
+            while stack and not (n["ts"] >= stack[-1]["ts"]
+                                 and end <= stack[-1]["ts"]
+                                 + stack[-1]["dur"]):
+                stack.pop()
+            (stack[-1]["children"] if stack else roots).append(n)
+            if n["ph"] == "X":
+                stack.append(n)
+        return {"request_id": request_id, "span_count": len(nodes),
+                "spans": roots}
+
+    def export_request(self, request_id: str,
+                       path: Optional[str] = None) -> dict:
+        """One request's events as a Chrome trace-event document —
+        the same format ``export_chrome`` writes, filtered to the
+        request — written atomically to ``path`` when given."""
+        events = self.request_events(request_id)
+        doc = {
+            "traceEvents": self._thread_metadata(events) + events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "producer": "bigdl_tpu.obs",
+                "epoch_unix": self._epoch_unix,
+                "request_id": request_id,
+            },
+        }
+        if path:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, path)
+        return doc
 
     def _thread_metadata(self, events: list) -> list:
         """Chrome 'M' thread_name rows so Perfetto shows thread names

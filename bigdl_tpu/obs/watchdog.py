@@ -242,6 +242,17 @@ class StallWatchdog:
                 self.on_stall(event)
             except Exception:
                 log.exception("watchdog on_stall callback failed")
+        # a stall is a first-class incident: dump the correlated bundle
+        # (last spans + time-series window + the diagnostics captured
+        # above) if the process flight recorder is armed
+        try:
+            from bigdl_tpu.obs import flight
+            flight.get_flight_recorder().record(
+                "stall",
+                {k: v for k, v in event.items() if k != "thread_stacks"},
+                key=self.name)
+        except Exception:
+            log.exception("watchdog flight-recorder dump failed")
         return event
 
     # -- watcher thread ------------------------------------------------- #

@@ -186,6 +186,15 @@ class FaultInjector:
         from bigdl_tpu.obs import get_registry
         get_registry().counter("resilience/faults_injected").add(1)
         log.info("fault injected: %s at %s", spec.describe(), site)
+        # every fire is an incident candidate; the recorder's per-site
+        # dedup window collapses a chaos sweep to one bundle per site
+        try:
+            from bigdl_tpu.obs import flight
+            flight.get_flight_recorder().record(
+                "fault_injected",
+                {"site": site, "spec": spec.describe()}, key=site)
+        except Exception:
+            log.exception("fault flight-recorder dump failed")
 
     def stats(self) -> dict:
         # aggregate per describe(): a chaos schedule arms many

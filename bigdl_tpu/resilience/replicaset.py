@@ -166,6 +166,20 @@ class ReplicaSet:
         self._closed = False
         self._publish_open_circuits()
         self._publish_replica_count()
+        # flight-recorder state: circuit states + pending depth land in
+        # every incident bundle (weakref — a closed set must be
+        # collectable; latest set wins the key)
+        try:
+            import weakref
+            from bigdl_tpu.obs import flight
+            wself = weakref.ref(self)
+
+            def _flight_state():
+                rs = wself()
+                return rs.stats() if rs is not None else None
+            flight.register_state("replicaset", _flight_state)
+        except Exception:
+            pass
 
     def _publish_replica_count(self) -> None:
         n = sum(1 for r in self._replicas if r.state != DRAINING)
@@ -261,7 +275,13 @@ class ReplicaSet:
     def _dispatch_batch(self, x_padded: np.ndarray):
         """Batcher callback: run on the best replica, re-dispatching a
         failed batch to another (bounded) so an accepted request only
-        fails when the whole set is down."""
+        fails when the whole set is down.  The batcher binds the
+        batch's request ids to this thread before calling, so the
+        failover hops land in every affected request's span tree."""
+        from bigdl_tpu.obs import flight
+        from bigdl_tpu.obs.tracer import get_request_context, get_tracer
+        tracer = get_tracer()
+        rids = list(get_request_context()) if tracer.enabled else []
         tried: set = set()
         redispatches = 0
         last: Optional[BaseException] = None
@@ -269,11 +289,23 @@ class ReplicaSet:
             rep = self._pick(tried)
             if rep is None:
                 self._registry.counter("resilience/backend_lost").add(1)
+                flight.get_flight_recorder().record(
+                    "backend_lost",
+                    {"reason": "no_replica_available",
+                     "tried": sorted(tried),
+                     "redispatches": redispatches,
+                     "error": str(last)},
+                    key="replicaset")
                 raise BackendLostError(
                     f"no serving replica available ({len(tried)} tried, "
                     f"{redispatches} re-dispatches): {last}") from last
             try:
-                y = rep.engine._run_batch(x_padded)
+                span_args = {"replica": rep.name, "attempt": redispatches}
+                if rids:
+                    span_args["request_ids"] = rids
+                with tracer.span("resilience/dispatch", cat="resilience",
+                                 **span_args):
+                    y = rep.engine._run_batch(x_padded)
             except Exception as e:  # noqa: BLE001 — classified below
                 self._record_failure(rep, e)
                 if classify_error(e) == "fatal":
@@ -285,10 +317,22 @@ class ReplicaSet:
                 redispatches += 1
                 if redispatches > self.max_redispatch:
                     self._registry.counter("resilience/backend_lost").add(1)
+                    flight.get_flight_recorder().record(
+                        "backend_lost",
+                        {"reason": "redispatch_bound",
+                         "tried": sorted(tried),
+                         "redispatches": redispatches,
+                         "error": str(e)},
+                        key="replicaset")
                     raise BackendLostError(
                         f"batch failed on {redispatches} replicas "
                         f"(re-dispatch bound reached): {e}") from e
                 self._registry.counter("resilience/failovers").add(1)
+                tracer.instant(
+                    "resilience/failover", cat="resilience",
+                    failed_replica=rep.name, redispatch=redispatches,
+                    error=f"{type(e).__name__}: {e}",
+                    **({"request_ids": rids} if rids else {}))
                 log.warning("replica %s failed a batch, re-dispatching "
                             "(%d/%d): %s", rep.name, redispatches,
                             self.max_redispatch, e)

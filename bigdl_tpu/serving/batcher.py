@@ -25,7 +25,8 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from bigdl_tpu.obs.tracer import get_tracer
+from bigdl_tpu.obs.tracer import (clear_request_context, get_tracer,
+                                  mint_request_id, set_request_context)
 from bigdl_tpu.resilience.errors import ServingOverloaded, TransientBackendError
 
 _tracer = get_tracer()
@@ -46,9 +47,17 @@ def count_rejection() -> None:
     raised at an admission seam (batcher, LM engine, SLO admission
     control) lands here, on top of the per-engine ``serving/rejected``
     / ``serving/lm/rejected`` gauges — one counter the SLO controller
-    and the goodput metric can read without knowing which engine shed."""
+    and the goodput metric can read without knowing which engine shed.
+    Also the shed-burst incident seam: the flight recorder counts
+    sheds here and dumps ONE correlated bundle when a burst crosses
+    its threshold (no-op while the recorder is disarmed)."""
     from bigdl_tpu.obs import get_registry
     get_registry().counter("serving/rejected_total", unit="requests").add(1)
+    try:
+        from bigdl_tpu.obs import flight
+        flight.note_shed()
+    except Exception:
+        pass  # forensics must never turn a shed into a crash
 
 
 def power_of_two_buckets(max_batch_size: int) -> tuple:
@@ -89,13 +98,14 @@ def _tree_concat(parts: list):
 
 
 class _Request:
-    __slots__ = ("x", "n", "future", "t_enqueue")
+    __slots__ = ("x", "n", "future", "t_enqueue", "rid")
 
-    def __init__(self, x, n: int, future: Future):
+    def __init__(self, x, n: int, future: Future, rid: str):
         self.x = x
         self.n = n
         self.future = future
         self.t_enqueue = time.perf_counter()
+        self.rid = rid
 
 
 def _safe_resolve(future: Future, *, result=None, exc=None) -> None:
@@ -155,6 +165,23 @@ class DynamicBatcher:
         else:
             threading.Thread(target=self._loop_guard, daemon=True,
                              name="bigdl-tpu-batcher").start()
+        # flight-recorder hookup (latest batcher wins the key; weakref
+        # so the provider never keeps a closed batcher alive)
+        try:
+            from bigdl_tpu.obs import flight
+            import weakref
+            wself = weakref.ref(self)
+
+            def _active_rids():
+                b = wself()
+                if b is None:
+                    return []
+                with b._cv:
+                    return ([r.rid for r in b._queue]
+                            + [r.rid for r in b._inflight])
+            flight.register_requests("batcher", _active_rids)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------ #
     def bucket_for(self, n: int) -> int:
@@ -186,6 +213,7 @@ class DynamicBatcher:
             raise ServingOverloaded(
                 f"admission shed (injected at serving.enqueue): {e}") from e
         fut: Future = Future()
+        rid = mint_request_id()
         with self._cv:
             if self._stop:
                 raise ServingClosed("batcher is closed")
@@ -196,13 +224,18 @@ class DynamicBatcher:
                 raise ServingQueueFull(
                     f"request queue full ({self._max_queue} pending); "
                     "retry later or raise max_queue")
-            self._queue.append(_Request(x, n, fut))
+            self._queue.append(_Request(x, n, fut, rid))
             depth = len(self._queue)
             self._cv.notify()
+        fut.request_id = rid  # clients correlate responses with traces
         if self._metrics is not None:
             self._metrics.record_submit()
-        _tracer.instant("serve/enqueue", cat="serve", n=n,
-                        queue_depth=depth)
+        if _tracer.sampled(rid):
+            _tracer.instant("serve/enqueue", cat="serve", n=n,
+                            queue_depth=depth, request_id=rid)
+        else:
+            _tracer.instant("serve/enqueue", cat="serve", n=n,
+                            queue_depth=depth)
         return fut
 
     def pending(self) -> int:
@@ -290,30 +323,45 @@ class DynamicBatcher:
                 self._cv.wait(timeout=min(remaining, 0.05))
             return batch
 
-    def _dispatch(self, xs: list, bucket: int):
-        """Pad a concatenated batch to ``bucket`` rows and run it."""
+    def _dispatch(self, xs: list, bucket: int, rids=()):
+        """Pad a concatenated batch to ``bucket`` rows and run it.
+        ``rids`` (the batch's request ids) ride the batch-level spans
+        and — via the request context — reach layers below ``run_batch``
+        (the ReplicaSet failover hop) that only see a padded array."""
         total = sum(int(x.shape[0]) for x in xs)
+        traced = [r for r in rids if _tracer.sampled(r)]
         with _tracer.span("serve/assemble", cat="serve",
-                          requests=len(xs), rows=total, bucket=bucket):
+                          requests=len(xs), rows=total, bucket=bucket,
+                          **({"request_ids": traced} if traced else {})):
             parts = list(xs)
             if bucket > total:
                 parts.append(np.zeros(
                     (bucket - total,) + tuple(xs[0].shape[1:]),
                     xs[0].dtype))
             joined = parts[0] if len(parts) == 1 else np.concatenate(parts, 0)
-        with _tracer.span("serve/device", cat="serve", bucket=bucket):
-            return self._run(joined)
+        set_request_context(rids)
+        try:
+            with _tracer.span("serve/device", cat="serve", bucket=bucket,
+                              **({"request_ids": traced} if traced
+                                 else {})):
+                return self._run(joined)
+        finally:
+            clear_request_context()
 
     def _serve_batch(self, batch: list) -> None:
         t_start = time.perf_counter()
         waits = [t_start - r.t_enqueue for r in batch]
         total = sum(r.n for r in batch)
+        rids = [r.rid for r in batch]
         if _tracer.enabled:
             # queue-wait spans are known only now — record retroactively
             # from each request's enqueue timestamp
             for r, w in zip(batch, waits):
+                args = {"n": r.n}
+                if _tracer.sampled(r.rid):
+                    args["request_id"] = r.rid
                 _tracer.add_complete("serve/queue_wait", r.t_enqueue, w,
-                                     cat="serve", args={"n": r.n})
+                                     cat="serve", args=args)
         try:
             if total > self._max_batch:
                 # one oversized request: chunk through max-size slices
@@ -322,7 +370,7 @@ class DynamicBatcher:
                 for i in range(0, req.n, self._max_batch):
                     piece = req.x[i:i + self._max_batch]
                     b = self.bucket_for(int(piece.shape[0]))
-                    y = _tree_np(self._dispatch([piece], b))
+                    y = _tree_np(self._dispatch([piece], b, rids))
                     outs.append(_tree_slice(y, 0, int(piece.shape[0])))
                 result = _tree_concat(outs)
                 bucket_rows = sum(
@@ -332,7 +380,7 @@ class DynamicBatcher:
             else:
                 bucket_rows = self.bucket_for(total)
                 y = _tree_np(self._dispatch([r.x for r in batch],
-                                            bucket_rows))
+                                            bucket_rows, rids))
                 ys, off = [], 0
                 for r in batch:
                     ys.append(_tree_slice(y, off, off + r.n))
@@ -351,6 +399,16 @@ class DynamicBatcher:
                 _safe_resolve(r.future, result=yr)
                 if self._metrics is not None:
                     self._metrics.record_done(done - r.t_enqueue)
+        if _tracer.enabled:
+            # the per-request ROOT span (enqueue -> resolved): every
+            # phase above nests inside it by interval containment, so
+            # span_tree() gets its one top-level node for free
+            for r in batch:
+                if _tracer.sampled(r.rid):
+                    _tracer.add_complete(
+                        "serve/request", r.t_enqueue,
+                        done - r.t_enqueue, cat="serve",
+                        args={"request_id": r.rid, "n": r.n})
 
     def _loop(self) -> None:
         while True:

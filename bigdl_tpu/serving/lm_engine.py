@@ -54,6 +54,7 @@ memory, not just slots.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
@@ -63,6 +64,7 @@ import numpy as np
 
 from bigdl_tpu.obs import get_registry, get_tracer
 from bigdl_tpu.obs.registry import FnGauge, Histogram
+from bigdl_tpu.obs.tracer import mint_request_id
 from bigdl_tpu.resilience.errors import (ServingOverloaded,
                                          TransientBackendError)
 from bigdl_tpu.serving.batcher import (ServingClosed, ServingQueueFull,
@@ -73,6 +75,7 @@ from bigdl_tpu.serving.kvcache import (BlockPool, PoolExhausted, RadixCache,
 from bigdl_tpu.utils.engine import select_platform
 
 _tracer = get_tracer()
+log = logging.getLogger("bigdl_tpu.serving")
 
 
 def prefill_bucket_lengths(max_len: int, min_bucket: int = 8) -> tuple:
@@ -99,9 +102,11 @@ class LMStream:
     inter-token-latency metrics and are readable per request.
     """
 
-    def __init__(self, prompt_1b: np.ndarray, max_new: int):
+    def __init__(self, prompt_1b: np.ndarray, max_new: int,
+                 request_id: Optional[str] = None):
         self.prompt = prompt_1b
         self.max_new = int(max_new)
+        self.request_id = request_id    # trace/flight correlation handle
         self._tokens: List[int] = []
         self._cond = threading.Condition()
         self._done = False
@@ -292,10 +297,10 @@ class LMMetrics:
 # ---------------------------------------------------------------------- #
 class _Request:
     __slots__ = ("stream", "prompt0", "max_new", "temperature", "eos0",
-                 "first_key", "step_keys")
+                 "first_key", "step_keys", "rid")
 
     def __init__(self, stream, prompt0, max_new, temperature, eos0,
-                 first_key, step_keys):
+                 first_key, step_keys, rid):
         self.stream = stream
         self.prompt0 = prompt0          # (t,) int32, 0-based
         self.max_new = max_new
@@ -303,17 +308,19 @@ class _Request:
         self.eos0 = eos0                # 0-based eos id or None
         self.first_key = first_key      # np (2,) uint32 or None
         self.step_keys = step_keys      # np (max_new-1, 2) or None
+        self.rid = rid                  # request id (tracing/forensics)
 
 
 class _Slot:
     __slots__ = ("stream", "pos_next", "last0", "remaining", "step_idx",
                  "temperature", "eos0", "step_keys", "last_emit_at",
                  "blocks", "table", "draft_ok", "demoted", "accept_ema",
-                 "spec_rounds", "probe_in")
+                 "spec_rounds", "probe_in", "rid")
 
     def __init__(self, req: _Request, prompt_len: int, first0: int,
                  blocks: List[int], table: np.ndarray):
         self.stream = req.stream
+        self.rid = req.rid
         self.pos_next = prompt_len      # next cache position to write
         self.last0 = first0             # last emitted token, 0-based
         self.remaining = req.max_new - 1
@@ -598,6 +605,33 @@ class LMServingEngine:
         self._worker = threading.Thread(
             target=self._run, daemon=True, name=f"lm-serve-{name}")
         self._worker.start()
+        # flight-recorder hookup: incident bundles capture the engine's
+        # scheduler/kv state and the active request ids.  weakref'd so
+        # a closed engine is collectable.
+        try:
+            from bigdl_tpu.obs import flight
+            import weakref
+            ref = weakref.ref(self)
+
+            def _flight_state():
+                eng = ref()
+                return eng.stats() if eng is not None else None
+
+            def _flight_requests():
+                eng = ref()
+                if eng is None:
+                    return []
+                with eng._cv:
+                    rids = [r.rid for r in eng._queue]
+                    rids += [st.rid for st in eng._slots
+                             if st is not None]
+                return rids
+
+            flight.register_state(f"lm_engine/{name}", _flight_state)
+            flight.register_requests(f"lm_engine/{name}",
+                                     _flight_requests)
+        except Exception:
+            log.exception("flight-recorder registration failed")
 
     def _publish_kv_metrics(self, registry) -> None:
         registry.register("kvcache/block_utilization",
@@ -818,9 +852,10 @@ class LMServingEngine:
             raise ServingOverloaded(
                 f"admission shed (injected at serving.enqueue): {e}") from e
 
-        stream = LMStream(prompt, max_new)
+        rid = mint_request_id()
+        stream = LMStream(prompt, max_new, request_id=rid)
         req = _Request(stream, prompt - 1, max_new, temp, eos0,
-                       first_key, step_keys)
+                       first_key, step_keys, rid)
         with self._cv:
             if self._closing:
                 raise ServingClosed("LMServingEngine is closed")
@@ -830,8 +865,13 @@ class LMServingEngine:
                 raise ServingQueueFull(
                     f"admission queue full ({self._max_queue})")
             self._queue.append(req)
+            depth = len(self._queue)
             self._cv.notify_all()
         self.metrics.record_submit()
+        if _tracer.sampled(rid):
+            _tracer.instant("lm/enqueue", cat="serve", request_id=rid,
+                            prompt_len=t, max_new=max_new,
+                            queue_depth=depth)
         return stream
 
     # -- live control knobs (the SLO controller's actuators) ----------- #
@@ -938,6 +978,13 @@ class LMServingEngine:
         matched: List[int] = []
         if self.radix is not None:
             matched = self.radix.match(req.prompt0)  # retains for us
+        traced = _tracer.sampled(req.rid)
+        if traced and self.radix is not None:
+            _tracer.instant("lm/radix_match", cat="serve",
+                            request_id=req.rid,
+                            matched_blocks=len(matched),
+                            matched_tokens=len(matched) * B,
+                            prompt_len=t)
         n_new = need_total - len(matched)
         try:
             fresh = self.pool.alloc(n_new)
@@ -951,12 +998,38 @@ class LMServingEngine:
                     self.pool.release(matched)
                 return False
         blocks = matched + fresh
+        if traced:
+            # queue wait is known only now, at successful admission —
+            # retroactive, the batcher's serve/queue_wait idiom
+            wait = time.perf_counter() - req.stream.submitted_at
+            _tracer.add_complete("lm/queue_wait",
+                                 req.stream.submitted_at, wait,
+                                 cat="serve",
+                                 args={"request_id": req.rid, "slot": slot})
         try:
             self._prefill_into(req, blocks, slot, len(matched) * B)
         except BaseException:
             self.pool.release(blocks)
             raise
         return True
+
+    @staticmethod
+    def _trace_done(stream: LMStream, rid: Optional[str]) -> None:
+        """Retroactive per-request ROOT span (submit -> finish) — the
+        natural parent every lm/* event of the request nests under in
+        ``Tracer.span_tree``.  Recorded at completion because only then
+        is the request's full extent known."""
+        if not _tracer.sampled(rid):
+            return
+        end = stream.finished_at
+        if end is None:
+            end = time.perf_counter()
+        _tracer.add_complete(
+            "lm/request", stream.submitted_at,
+            end - stream.submitted_at, cat="serve",
+            args={"request_id": rid, "prompt_len": int(len(stream.prompt)),
+                  "max_new": stream.max_new,
+                  "emitted": len(stream._tokens)})
 
     def _prefill_into(self, req: _Request, blocks: List[int], slot: int,
                       matched_len: int) -> None:
@@ -965,6 +1038,8 @@ class LMServingEngine:
         largest = self.prefill_buckets[-1]
         p = matched_len
         logits = None
+        rid_args = ({"request_id": req.rid}
+                    if _tracer.sampled(req.rid) else {})
         while True:
             rem = t - p
             ts = rem if rem <= largest else self._chunk_full
@@ -972,7 +1047,7 @@ class LMServingEngine:
             ids = np.zeros((1, bucket), np.int32)
             ids[0, :ts] = req.prompt0[p:p + ts]
             with _tracer.span("lm/prefill", cat="serve", bucket=bucket,
-                              prompt_len=t, prefix_len=p):
+                              prompt_len=t, prefix_len=p, **rid_args):
                 if p == 0:
                     logits, k, v = self.prefill_cache(
                         self._params, self._buffers,
@@ -995,7 +1070,7 @@ class LMServingEngine:
             owned = blocks[p // B:p // B + nb_w]
             ids_w[:len(owned)] = owned
             with _tracer.span("lm/insert", cat="serve", slot=slot,
-                              bucket=bucket):
+                              bucket=bucket, **rid_args):
                 self.pool.k, self.pool.v = self._insert_compiled(bucket)(
                     self.pool.k, self.pool.v, k, v, ids_w)
             p += ts
@@ -1017,6 +1092,7 @@ class LMServingEngine:
                                 and first0 == req.eos0):
             req.stream._finish()
             self.metrics.record_complete()
+            self._trace_done(req.stream, req.rid)
             self.pool.release(blocks)
             with self._cv:
                 self._free.append(slot)
@@ -1049,6 +1125,7 @@ class LMServingEngine:
                 tables[i] = st.table
         if not active:
             return
+        t0 = time.perf_counter()
         with _tracer.span("lm/decode_step", cat="serve",
                           active=len(active)):
             logits, self.pool.k, self.pool.v = self._decode_compiled()(
@@ -1056,6 +1133,15 @@ class LMServingEngine:
                 self.pool.v)
             logits = np.asarray(logits)  # sync; (S, V) f32
         now = time.perf_counter()
+        if _tracer.enabled:
+            # per-request view of the shared batched step: one
+            # retroactive span per sampled slot, all spanning [t0, now]
+            for i, st in active:
+                if _tracer.sampled(st.rid):
+                    _tracer.add_complete(
+                        "lm/decode_round", t0, now - t0, cat="serve",
+                        args={"request_id": st.rid, "slot": i,
+                              "step": st.step_idx})
         itls = []
         freed = []
         for i, st in enumerate(self._slots):
@@ -1082,7 +1168,9 @@ class LMServingEngine:
         if freed:
             with self._cv:
                 for i in freed:
-                    self.pool.release(self._slots[i].blocks)
+                    st = self._slots[i]
+                    self._trace_done(st.stream, st.rid)
+                    self.pool.release(st.blocks)
                     self._slots[i] = None
                     self._free.append(i)
                     self._n_active -= 1
@@ -1144,6 +1232,10 @@ class LMServingEngine:
                 st.demoted = True
                 st.probe_in = cfg.probe_interval
                 self.spec_metrics.record_demotion(fault=True)
+                if _tracer.sampled(st.rid):
+                    _tracer.instant("lm/demote", cat="serve",
+                                    request_id=st.rid, slot=i,
+                                    reason="verify_fault")
             drafts = {}
             jobs = {}
 
@@ -1167,6 +1259,7 @@ class LMServingEngine:
             tables[i] = st.table
         if not active:
             return
+        t0 = time.perf_counter()
         with _tracer.span("lm/verify_step", cat="serve",
                           active=len(active), speculating=len(jobs)):
             logits, self.pool.k, self.pool.v = self._verify_compiled()(
@@ -1174,6 +1267,15 @@ class LMServingEngine:
                 self.pool.k, self.pool.v)
             logits = np.asarray(logits)  # sync; (S, W, V) f32
         now = time.perf_counter()
+        if _tracer.enabled:
+            for i in active:
+                st = self._slots[i]
+                if _tracer.sampled(st.rid):
+                    _tracer.add_complete(
+                        "lm/verify_round", t0, now - t0, cat="serve",
+                        args={"request_id": st.rid, "slot": i,
+                              "step": st.step_idx,
+                              "speculating": i in jobs})
         itls = []
         freed = []
         n_emitted = 0
@@ -1220,6 +1322,11 @@ class LMServingEngine:
                     st.demoted = True
                     st.probe_in = cfg.probe_interval
                     self.spec_metrics.record_demotion()
+                    if _tracer.sampled(st.rid):
+                        _tracer.instant("lm/demote", cat="serve",
+                                        request_id=st.rid, slot=i,
+                                        reason="acceptance_collapse",
+                                        accept_ema=round(st.accept_ema, 4))
             if finished:
                 st.stream._finish()
                 self.metrics.record_complete()
@@ -1235,7 +1342,9 @@ class LMServingEngine:
         if freed:
             with self._cv:
                 for i in freed:
-                    self.pool.release(self._slots[i].blocks)
+                    st = self._slots[i]
+                    self._trace_done(st.stream, st.rid)
+                    self.pool.release(st.blocks)
                     self._slots[i] = None
                     if self.draft is not None:
                         self.draft.release(i)

@@ -8,7 +8,13 @@ module, so there is exactly ONE schema:
 
     {"tool": "chip_opportunist",
      "incidents": [{"ts_unix": <float>, "ts": "<iso>",
-                    "stage": "<stage name>", "rc": <int>}, ...]}
+                    "stage": "<stage name>", "rc": <int>,
+                    "flight": "<FLIGHT_*.json basename>"?}, ...]}
+
+``flight`` is optional: when the obs flight recorder dumped a
+correlated bundle for the incident, the row points at it (basename
+only — both files live in the repo root), so the ledger and the
+forensics bundle cross-reference each other.
 
 Reads ride :func:`bigdl_tpu.utils.artifacts.load_artifact` — an
 existing-but-corrupt file is treated as absent with a loud warning
@@ -65,19 +71,25 @@ def inter_incident_gaps(incidents: List[dict]) -> List[float]:
 
 def append_incident(stage: str, rc: int, path: str = DEFAULT_PATH, *,
                     tool: str = "chip_opportunist",
-                    now: Optional[float] = None) -> dict:
+                    now: Optional[float] = None,
+                    flight: Optional[str] = None) -> dict:
     """Append one incident row atomically; an unreadable existing file
-    starts a fresh log (load_artifact already warned)."""
+    starts a fresh log (load_artifact already warned).  ``flight``
+    attaches the row's flight-recorder bundle pointer when one was
+    dumped for this incident."""
     doc = load_artifact(path)
     if not (isinstance(doc, dict) and isinstance(doc.get("incidents"), list)):
         doc = {"tool": tool, "incidents": []}
     t = time.time() if now is None else float(now)
-    doc["incidents"].append({
+    row = {
         "ts_unix": round(t, 1),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(t)),
         "stage": str(stage),
         "rc": int(rc),
-    })
+    }
+    if flight:
+        row["flight"] = str(flight)
+    doc["incidents"].append(row)
     write_artifact(path, doc)
     return doc
 
@@ -91,8 +103,10 @@ def _main(argv=None) -> int:
     app.add_argument("stage")
     app.add_argument("rc", type=int)
     app.add_argument("--path", default=DEFAULT_PATH)
+    app.add_argument("--flight", default=None,
+                     help="FLIGHT_*.json bundle basename for this row")
     args = ap.parse_args(argv)
-    append_incident(args.stage, args.rc, args.path)
+    append_incident(args.stage, args.rc, args.path, flight=args.flight)
     return 0
 
 

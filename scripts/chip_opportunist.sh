@@ -120,6 +120,7 @@ ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json TUNE_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
 BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json BENCH_MESH.json \
 BENCH_SPEC.json \
+FLIGHT_*.json TRACE_*.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
 SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
@@ -132,8 +133,14 @@ SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
 # format (atomic append, corrupt-file tolerant) for this recorder AND
 # the schedule builder, so the two can never drift apart.
 record_incident() {  # record_incident <stage> <rc>
-  python -m bigdl_tpu.traffic.incidents append "$1" "$2" \
-    >> "$LOG" 2>&1 || true
+  # Preferred path: a full flight-recorder bundle (spans + telemetry
+  # window + diagnose_tpu + serving state) with the ledger row appended
+  # through the same incidents writer, carrying a pointer to the
+  # bundle.  Falls back to the bare ledger append so an obs-layer bug
+  # can never lose the incident row itself.
+  python -m bigdl_tpu.obs.flight dump "$1" "$2" >> "$LOG" 2>&1 \
+    || python -m bigdl_tpu.traffic.incidents append "$1" "$2" \
+      >> "$LOG" 2>&1 || true
 }
 
 commit_artifacts() {  # commit_artifacts <message>

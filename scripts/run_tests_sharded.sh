@@ -17,15 +17,26 @@ N=${N:-3}
 # test_spec_decode.py carries the serving.verify site (a transient
 # demotes speculating slots instead of killing streams) and the
 # acceptance-collapse demotion matrix.
+# Observability gate first (OBS_GATE=0 skips): tracing, the metric
+# registry, the telemetry sampler, and the flight recorder are the
+# instruments every OTHER failure is diagnosed with — a broken
+# instrument should fail the run in seconds, before anything else
+# burns minutes producing evidence nothing can read.
+if [ "${OBS_GATE:-1}" = "1" ]; then
+  python -m pytest tests/test_obs.py tests/test_flight.py \
+    -q -m "not slow" || exit 1
+fi
+
 if [ "${FAULTS_GATE:-1}" = "1" ]; then
   python -m pytest tests/test_resilience.py tests/test_traffic.py \
     tests/test_kvcache.py tests/test_spec_decode.py -q -m faults || exit 1
 fi
 
-# Artifact schema lint: committed BENCH_*/TUNE_*/PROFILE_* files are
-# the evidence chain — a truncated or key-drifted one fails silently
-# downstream (resume identity never matches, regen skips rows), so it
-# should fail loudly here, in seconds.
+# Artifact schema lint: committed BENCH_*/TUNE_*/PROFILE_*/TRACE_*/
+# FLIGHT_* files are the evidence chain — a truncated or key-drifted
+# one fails silently downstream (resume identity never matches, regen
+# skips rows, a forensic bundle reads as empty), so it should fail
+# loudly here, in seconds.
 python scripts/validate_artifact.py || exit 1
 
 # Kernel correctness gate: the attention crossover + paged-decode

@@ -1,9 +1,9 @@
 #!/usr/bin/env python
 """Schema lint for committed measurement artifacts.
 
-Every BENCH_*/TUNE_*/PROFILE_* JSON in the repo root is part of the
-evidence chain the round-end driver and the scaling regeneration
-consume — a truncated or key-drifted artifact fails SILENTLY there
+Every BENCH_*/TUNE_*/PROFILE_*/TRACE_*/FLIGHT_* JSON in the repo root
+is part of the evidence chain the round-end driver and the scaling
+regeneration consume — a truncated or key-drifted artifact fails SILENTLY there
 (rows skipped, resume identity never matching, `complete` read as
 falsy).  This linter makes the contract explicit and cheap to check:
 
@@ -15,6 +15,10 @@ falsy).  This linter makes the contract explicit and cheap to check:
     contract: false until the final flush), a platform tag
     (``platform`` or ``inner_platform`` — rows without one can be
     mistaken for chip numbers), and a list-of-dicts ``rows`` section;
+  * TRACE_* files must satisfy the Chrome trace-event contract
+    (delegated to scripts/validate_trace.py);
+  * FLIGHT_* incident bundles must carry every correlated section
+    (spans, timeseries, state, diagnose_tpu, ...) and ``complete``;
   * anything else must at least self-identify with a ``metric`` key.
 
 Usage:
@@ -32,7 +36,56 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: repo-root artifact families under the resumable-measurement contract
-PATTERNS = ("BENCH_*.json", "TUNE_*.json", "PROFILE_*.json")
+PATTERNS = ("BENCH_*.json", "TUNE_*.json", "PROFILE_*.json",
+            "TRACE_*.json", "FLIGHT_*.json")
+
+#: FlightRecorder bundle contract (bigdl_tpu.obs.flight._dump): every
+#: key must be present — a partial bundle means the dump died mid-write
+#: and the forensic evidence cannot be trusted
+FLIGHT_KEYS = ("flight", "ts_unix", "ts", "detail", "spans",
+               "active_requests", "timeseries", "state", "registry",
+               "diagnose_tpu", "complete")
+
+
+def _flight_problems(doc) -> list:
+    """FLIGHT_*.json: the incident bundle is correlated evidence (spans
+    + time-series window + diagnostics captured at one instant) — it
+    has neither ``rows`` nor ``metric``, so it gets its own contract."""
+    probs = []
+    if not isinstance(doc, dict):
+        return ["flight bundle top level is %s, expected object"
+                % type(doc).__name__]
+    for k in FLIGHT_KEYS:
+        if k not in doc:
+            probs.append("flight bundle lacks %r" % k)
+    if doc.get("complete") is not True:
+        probs.append("flight bundle 'complete' must be true "
+                     "(bundles are written atomically or not at all)")
+    if "spans" in doc:
+        spans = doc["spans"]
+        if not isinstance(spans, list):
+            probs.append("'spans' is not a list")
+        elif not all(isinstance(s, dict) for s in spans):
+            probs.append("'spans' holds non-object entries")
+    if "timeseries" in doc and not isinstance(doc["timeseries"], list):
+        probs.append("'timeseries' is not a list")
+    if "state" in doc and not isinstance(doc["state"], dict):
+        probs.append("'state' is not an object")
+    if "active_requests" in doc \
+            and not isinstance(doc["active_requests"], dict):
+        probs.append("'active_requests' is not an object")
+    return probs
+
+
+def _trace_problems(path: str) -> list:
+    """TRACE_*.json delegates to validate_trace (Chrome trace-event
+    contract: known phases, ts/dur present, monotonic-safe)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from validate_trace import validate_trace
+    finally:
+        sys.path.pop(0)
+    return validate_trace(path)
 
 
 def _mesh_problems(doc) -> list:
@@ -98,6 +151,8 @@ def _problems(doc, name: str = "") -> list:
             if not isinstance(rec, dict) or "metric" not in rec:
                 probs.append("jsonl record %d lacks a 'metric' key" % i)
         return probs
+    if name.startswith("FLIGHT_"):
+        return _flight_problems(doc)
     if not isinstance(doc, dict):
         return ["top level is %s, expected object" % type(doc).__name__]
     if "cmd" in doc and "rc" in doc:
@@ -128,6 +183,9 @@ def _problems(doc, name: str = "") -> list:
 
 def validate(path: str) -> list:
     """Problems for one file ([] = clean)."""
+    base = os.path.basename(path)
+    if base.startswith("TRACE_"):
+        return _trace_problems(path)
     try:
         with open(path) as f:
             text = f.read()

@@ -526,3 +526,204 @@ def test_transfer_chunks_are_traced(global_trace):
               if e["name"] == "h2d/chunk"]
     assert len(chunks) >= 4  # 256KB / 64KB
     assert all(e["args"]["bytes"] <= 64 * 1024 for e in chunks)
+
+
+# --------------------------------------------------------------------- #
+# percentile_from_counts edge cases (pinned: empty window, single
+# bucket, overflow-bucket mass, torn negative deltas)
+# --------------------------------------------------------------------- #
+
+def test_percentile_from_counts_empty_window_is_none():
+    from bigdl_tpu.obs.registry import _EDGES, percentile_from_counts
+    assert percentile_from_counts([], 99) is None
+    assert percentile_from_counts([0] * (len(_EDGES) + 1), 50) is None
+
+
+def test_percentile_from_counts_single_bucket():
+    from bigdl_tpu.obs.registry import _EDGES, percentile_from_counts
+    counts = [0] * (len(_EDGES) + 1)
+    counts[7] = 42  # all mass in one in-range bucket
+    for p in (1, 50, 99, 100):
+        assert percentile_from_counts(counts, p) == _EDGES[7]
+
+
+def test_percentile_from_counts_overflow_bucket_mass():
+    from bigdl_tpu.obs.registry import (_EDGES, OVERFLOW_EDGE,
+                                        percentile_from_counts)
+    counts = [0] * (len(_EDGES) + 1)
+    counts[-1] = 5  # everything past the last edge (stalled window)
+    got = percentile_from_counts(counts, 99)
+    assert got == OVERFLOW_EDGE
+    # strictly greater than every real edge: overflow mass can never
+    # make the window look healthier than the instrumented range
+    assert got > _EDGES[-1]
+    # finite, so it survives strict-JSON artifact writers
+    assert got == pytest.approx(got) and got != float("inf")
+    # caller-supplied ceiling is honored
+    assert percentile_from_counts(counts, 99, overflow=123.0) == 123.0
+
+
+def test_percentile_from_counts_mixed_and_negative_deltas():
+    from bigdl_tpu.obs.registry import _EDGES, OVERFLOW_EDGE, \
+        percentile_from_counts
+    counts = [0] * (len(_EDGES) + 1)
+    counts[3] = 90
+    counts[-1] = 10
+    assert percentile_from_counts(counts, 50) == _EDGES[3]
+    assert percentile_from_counts(counts, 99) == OVERFLOW_EDGE
+    # a torn counts-delta (negative entry) is clamped, not corrupting
+    torn = list(counts)
+    torn[0] = -7
+    assert percentile_from_counts(torn, 50) == _EDGES[3]
+
+
+def test_histogram_windowed_percentile_via_counts_delta():
+    from bigdl_tpu.obs.registry import percentile_from_counts
+    h = Histogram()
+    for _ in range(100):
+        h.observe(0.001)
+    before = h.counts()
+    for _ in range(100):
+        h.observe(1.0)  # the window being measured
+    delta = [c - p for c, p in zip(h.counts(), before)]
+    p50 = percentile_from_counts(delta, 50)
+    assert p50 is not None and 0.9 <= p50 <= 1.2  # window only
+
+
+# --------------------------------------------------------------------- #
+# tracer: concurrent writers, stable export, request sampling
+# --------------------------------------------------------------------- #
+
+def test_tracer_export_stable_under_concurrent_writers(tmp_path):
+    tr = Tracer(capacity=4096, enabled=True)
+    stop = threading.Event()
+
+    def writer(k):
+        i = 0
+        while not stop.is_set():
+            with tr.span(f"w{k}/span", cat="t", i=i):
+                pass
+            tr.instant(f"w{k}/mark", cat="t")
+            i += 1
+
+    threads = [threading.Thread(target=writer, args=(k,))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.05)
+        evs1 = tr.events()
+        evs2 = tr.events()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    for evs in (evs1, evs2):
+        # stable ordering: sorted by timestamp even though writers
+        # interleave arbitrarily in the ring
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts)
+        # no torn spans: every complete event carries its full shape
+        for e in evs:
+            assert "name" in e and "ph" in e and "ts" in e
+            if e["ph"] == "X":
+                assert "dur" in e and e["dur"] >= 0
+    # export under load parses and validates
+    path = str(tmp_path / "TRACE_CONC.json")
+    tr.export_chrome(path)
+    assert validate_trace(path) == []
+
+
+def test_mint_request_id_unique_and_mine():
+    from bigdl_tpu.obs import mint_request_id
+    ids = {mint_request_id() for _ in range(100)}
+    assert len(ids) == 100
+    assert all(i.startswith("r%d-" % os.getpid()) for i in ids)
+
+
+def test_request_sampling_deterministic_and_rate_bounds():
+    tr = Tracer(enabled=True, sample_rate=1.0)
+    assert tr.sampled("r1-1") and tr.sampled("r1-2")
+    tr.set_sample_rate(0.0)
+    assert not tr.sampled("r1-1")
+    tr.set_sample_rate(0.5)
+    rids = ["r1-%d" % i for i in range(400)]
+    picks = [tr.sampled(r) for r in rids]
+    # deterministic: same rid -> same verdict, every time
+    assert picks == [tr.sampled(r) for r in rids]
+    frac = sum(picks) / len(picks)
+    assert 0.3 < frac < 0.7  # hash-split, not all-or-nothing
+    # disabled tracer samples nothing regardless of rate
+    off = Tracer(enabled=False, sample_rate=1.0)
+    assert not off.sampled("r1-1")
+
+
+def test_request_context_roundtrip_and_clear():
+    from bigdl_tpu.obs import (clear_request_context, get_request_context,
+                               set_request_context)
+    assert get_request_context() == ()
+    set_request_context(["r1-1", "r1-2"])
+    assert get_request_context() == ("r1-1", "r1-2")
+    # other threads see their own (empty) context
+    seen = {}
+    t = threading.Thread(
+        target=lambda: seen.setdefault("ctx", get_request_context()))
+    t.start()
+    t.join()
+    assert seen["ctx"] == ()
+    clear_request_context()
+    assert get_request_context() == ()
+
+
+# --------------------------------------------------------------------- #
+# registry cardinality cap
+# --------------------------------------------------------------------- #
+
+def test_registry_caps_cardinality_and_reports_it():
+    reg = MetricRegistry(max_metrics=10)
+    for i in range(10):
+        reg.counter("ok/%d" % i).add(1)
+    assert reg.cardinality() == 10
+    # past the cap: callers still get a LIVE metric (hot paths never
+    # crash or None-check), but the name is not registered
+    extra = reg.counter("over/0")
+    extra.add(5)
+    assert extra.get()[0] == 5.0
+    assert "over/0" not in reg.names()
+    assert reg.cardinality() == 10
+    assert reg.overflow_total() == 1
+    # register() of a new name at cap is likewise refused
+    reg.register("over/1", Counter(), replace=True)
+    assert "over/1" not in reg.names()
+    assert reg.overflow_total() == 2
+    # existing names keep working at cap
+    reg.counter("ok/3").add(1)
+    assert reg.overflow_total() == 2
+    snap = reg.snapshot()
+    assert snap["obs/registry_cardinality"]["value"] == 10.0
+    assert snap["obs/registry_overflow_total"]["value"] == 2.0
+    # the synthetic gauges do not occupy registry slots
+    assert "obs/registry_cardinality" not in reg.names()
+    reg.clear()
+    assert reg.cardinality() == 0 and reg.overflow_total() == 0
+
+
+def test_registry_cap_env_knob(monkeypatch):
+    monkeypatch.setenv("BIGDL_TPU_REGISTRY_MAX", "12")
+    assert MetricRegistry().max_metrics == 12
+    monkeypatch.setenv("BIGDL_TPU_REGISTRY_MAX", "1")  # floor of 8
+    assert MetricRegistry().max_metrics == 8
+    monkeypatch.delenv("BIGDL_TPU_REGISTRY_MAX")
+    assert MetricRegistry().max_metrics == \
+        MetricRegistry.DEFAULT_MAX_METRICS
+
+
+def test_quant_per_path_gauges_bounded_by_cap():
+    """The one unbounded per-key family the sweep found
+    (quant/max_abs_dequant_error/<path>) is held by the cap instead of
+    growing without limit."""
+    reg = MetricRegistry(max_metrics=8)
+    for i in range(50):
+        reg.gauge("quant/max_abs_dequant_error/layer%d" % i).set(0.1)
+    assert reg.cardinality() == 8
+    assert reg.overflow_total() == 42
