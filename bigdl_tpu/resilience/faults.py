@@ -17,10 +17,15 @@ Spec grammar (``;``-separated specs)::
     BIGDL_TPU_FAULTS="site:kind[:key=val[,key=val...]][;spec...]"
 
     site   hook-point name: transfer.chunk | engine.init |
-           serving.dispatch | serving.enqueue | serving.verify
+           serving.dispatch | serving.enqueue | serving.verify |
+           serving.migrate
            (more may be added freely; a transient at serving.verify
            demotes the speculating slots to plain decode instead of
-           killing their streams — see lm_engine._step_spec)
+           killing their streams — see lm_engine._step_spec; a
+           transient at serving.migrate retries the KV-chain export
+           via with_backoff, backend_lost makes the decode replica
+           re-prefill the migrated prompt — zero accepted loss either
+           way, see serving/disagg/coordinator.py)
     kind   transient     raise TransientBackendError
            backend_lost  raise BackendLostError
            die           alias of backend_lost (reads better for

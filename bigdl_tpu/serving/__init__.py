@@ -24,12 +24,14 @@ from bigdl_tpu.serving.batcher import (DynamicBatcher, ServingClosed,
                                        ServingOverloaded, ServingQueueFull,
                                        power_of_two_buckets)
 from bigdl_tpu.serving.compile_cache import CompileCache
+from bigdl_tpu.serving.disagg import DisaggCoordinator
 from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.host_transfer import HostStager
 from bigdl_tpu.serving.kvcache import (BlockPool, PoolExhausted, RadixCache,
                                        RequestExceedsPool)
-from bigdl_tpu.serving.lm_engine import (LMMetrics, LMServingEngine,
-                                         LMStream, prefill_bucket_lengths)
+from bigdl_tpu.serving.lm_engine import (KVHandoff, LMMetrics,
+                                         LMServingEngine, LMStream,
+                                         prefill_bucket_lengths)
 from bigdl_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 from bigdl_tpu.serving.placement import (DeviceTopology, MeshSlice,
                                          MeshSlicer, PlacementError,
@@ -42,6 +44,7 @@ __all__ = [
     "ServingMetrics", "LatencyHistogram", "ServingQueueFull",
     "ServingOverloaded", "ServingClosed", "power_of_two_buckets",
     "LMServingEngine", "LMStream", "LMMetrics", "prefill_bucket_lengths",
+    "DisaggCoordinator", "KVHandoff",
     "BlockPool", "RadixCache", "PoolExhausted", "RequestExceedsPool",
     "DeviceTopology", "MeshSlice", "MeshSlicer", "PlacementError",
     "PlacementPolicy", "serving_tp_rules", "shard_params_chunked",

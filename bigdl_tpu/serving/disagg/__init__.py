@@ -1,0 +1,8 @@
+"""Disaggregated prefill/decode serving: phase-dedicated replica
+pools, KV-chain migration over the chunked transfer path, and
+independent per-phase SLO scaling.  See ``coordinator.py`` for the
+design notes.
+"""
+from bigdl_tpu.serving.disagg.coordinator import DisaggCoordinator
+
+__all__ = ["DisaggCoordinator"]

@@ -36,11 +36,23 @@ Exhaustion is two distinct conditions with two distinct types:
 - :class:`PoolExhausted` (a ``RuntimeError``): the pool is full *right
   now*.  Transient by construction — blocks free as streams finish —
   so the engine defers the request instead of failing it.
+
+Migration (disaggregated prefill/decode serving): a finished prefill's
+block chain moves between pools as a **block-major wire payload**
+``(n, L, H, block_len, D)`` — ``export_chain`` gathers it to the host
+in bounded slices, ``adopt_chain`` allocates destination blocks
+all-or-nothing and scatters the payload back in over
+:func:`~bigdl_tpu.utils.transfer.chunked_device_put` (the 32 MB
+chunking rule: the round-4 relay died on one ~154 MB buffer, and a
+chain near ``cache_len`` at production geometry is that order of
+magnitude).  Block-major layout is deliberate: the wire's leading dim
+is the one both the d2h slicer and ``chunked_device_put`` chunk along,
+so no single slice ever exceeds the ceiling regardless of L.
 """
 from __future__ import annotations
 
 import threading
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 SCRATCH_BLOCK = 0
 
@@ -95,6 +107,7 @@ class BlockPool:
         # pop() from the tail hands out ascending ids first
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
         self._ref = [0] * self.num_blocks
+        self._adopt_jits: dict = {}  # padded wire width -> donated scatter
 
     # -- capacity -------------------------------------------------------- #
     @property
@@ -161,6 +174,148 @@ class BlockPool:
     def refcount(self, block: int) -> int:
         with self._lock:
             return self._ref[block]
+
+    # -- migration (disaggregated prefill/decode) ------------------------ #
+    @property
+    def block_bytes(self) -> int:
+        """Bytes of one block's k (== v) rows across all layers — the
+        wire unit both chunkers slice on."""
+        L, _, H, B, D = self.shape
+        return L * H * B * D * self.dtype.itemsize
+
+    def export_chain(self, blocks: Sequence[int], *,
+                     chunk_bytes: Optional[int] = None) -> dict:
+        """Gather ``blocks``' k/v rows to the host as a block-major
+        wire payload ``{"k", "v": (n, L, H, block_len, D) np, "blocks": n}``.
+
+        Device->host moves in slices of at most ``chunk_bytes`` (the
+        shared 32 MB transfer ceiling by default) along the block dim,
+        one in flight at a time — the same discipline as
+        ``chunked_device_put``, mirrored for the download leg.  The
+        caller keeps its references; exporting never touches refcounts.
+        """
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bigdl_tpu.utils.transfer import DEFAULT_CHUNK_BYTES
+        cb = int(chunk_bytes) if chunk_bytes else DEFAULT_CHUNK_BYTES
+        n = len(blocks)
+        L, _, H, B, D = self.shape
+        host_k = np.empty((n, L, H, B, D), self.dtype)
+        host_v = np.empty((n, L, H, B, D), self.dtype)
+        if n:
+            idx = jnp.asarray(list(blocks), jnp.int32)
+            # device-side gather + transpose to block-major wire layout
+            kc = jnp.moveaxis(self.k[:, idx], 0, 1)
+            vc = jnp.moveaxis(self.v[:, idx], 0, 1)
+            rows = max(1, cb // max(1, self.block_bytes))
+            for i in range(0, n, rows):
+                host_k[i:i + rows] = np.asarray(kc[i:i + rows])
+                host_v[i:i + rows] = np.asarray(vc[i:i + rows])
+        return {"k": host_k, "v": host_v, "blocks": n}
+
+    def _adopt_scatter(self, width: int):
+        """Donated scatter of a ``width``-block wire payload into the
+        arenas; one executable per padded wire width (powers of two),
+        padded entries target the scratch block with zero rows."""
+        exe = self._adopt_jits.get(width)
+        if exe is None:
+            import jax
+            import jax.numpy as jnp
+
+            def _scatter(k, v, kw, vw, ids):
+                k = k.at[:, ids].set(jnp.moveaxis(kw, 0, 1))
+                v = v.at[:, ids].set(jnp.moveaxis(vw, 0, 1))
+                return k, v
+
+            exe = jax.jit(_scatter, donate_argnums=(0, 1))
+            self._adopt_jits[width] = exe
+        return exe
+
+    def warmup_adopt(self, widths: Sequence[int]) -> int:
+        """Pre-compile AND prime the donated adopt scatters for the
+        given padded wire widths, so the first real migration doesn't
+        pay a mid-traffic compile.  Runs each executable once with a
+        zero payload aimed entirely at the scratch block — garbage
+        there is always masked — which also keeps the arenas resident
+        through the donation."""
+        import jax.numpy as jnp
+        import numpy as np
+        n = 0
+        for w in widths:
+            w = int(w)
+            if w < 1:
+                continue
+            kw = jnp.zeros((w, self.shape[0]) + self.shape[2:],
+                           self.dtype)
+            if getattr(self.k, "sharding", None) is not None:
+                import jax
+                kw = jax.device_put(kw, self.k.sharding)
+            idx = np.full((w,), SCRATCH_BLOCK, np.int32)
+            self.k, self.v = self._adopt_scatter(w)(
+                self.k, self.v, kw, kw, idx)
+            n += 1
+        return n
+
+    def adopt_chain(self, k_wire, v_wire, *, extra_blocks: int = 0,
+                    device=None, chunk_bytes: Optional[int] = None
+                    ) -> List[int]:
+        """Adopt an exported chain into THIS pool: allocate
+        ``n_wire + extra_blocks`` blocks (all-or-nothing — a partial
+        adoption would strand a half-migrated sequence), stage the wire
+        payload over ``chunked_device_put`` and scatter it into the
+        first ``n_wire`` of them.  Returns the new block ids, each at
+        refcount 1 (the adopting sequence's references).
+
+        ``extra_blocks`` reserves the generation tail in the same
+        atomic allocation.  ``device`` is the arena's committed
+        sharding/device (a placement slice's replicated sharding).  On
+        transfer failure every allocated block is released before the
+        error propagates — the pool is left exactly as found.
+        :class:`PoolExhausted` propagates untouched so callers keep the
+        typed defer path.
+        """
+        import numpy as np
+
+        from bigdl_tpu.utils.transfer import (DEFAULT_CHUNK_BYTES,
+                                              chunked_device_put)
+        k_wire = np.asarray(k_wire)
+        v_wire = np.asarray(v_wire)
+        n = int(k_wire.shape[0]) if k_wire.ndim else 0
+        if v_wire.shape != k_wire.shape:
+            raise ValueError(
+                f"k/v wire shapes differ: {k_wire.shape} vs {v_wire.shape}")
+        ids = self.alloc(n + max(0, int(extra_blocks)))
+        if n == 0:
+            return ids
+        cb = int(chunk_bytes) if chunk_bytes else DEFAULT_CHUNK_BYTES
+        try:
+            kw = chunked_device_put(k_wire, self.dtype, chunk_bytes=cb,
+                                    device=device)
+            vw = chunked_device_put(v_wire, self.dtype, chunk_bytes=cb,
+                                    device=device)
+            # pad the wire to a power-of-two width so the donated
+            # scatter compiles once per bucket; padded rows are zeros
+            # aimed at the scratch block (garbage there is masked)
+            width = 1
+            while width < n:
+                width *= 2
+            if width > n:
+                import jax.numpy as jnp
+                pad = jnp.zeros((width - n,) + kw.shape[1:], kw.dtype)
+                if device is not None:
+                    import jax
+                    pad = jax.device_put(pad, device)
+                kw = jnp.concatenate([kw, pad], axis=0)
+                vw = jnp.concatenate([vw, pad], axis=0)
+            idx = np.full((width,), SCRATCH_BLOCK, np.int32)
+            idx[:n] = ids[:n]
+            self.k, self.v = self._adopt_scatter(width)(
+                self.k, self.v, kw, vw, idx)
+        except BaseException:
+            self.release(ids)
+            raise
+        return ids
 
     # -- introspection --------------------------------------------------- #
     def stats(self) -> dict:

@@ -186,7 +186,15 @@ class LMMetrics:
 
     Occupancy is measured where continuous batching earns its keep: the
     fraction of slot-iterations that decoded a real request (a lockstep
-    engine pays for every slot every step regardless)."""
+    engine pays for every slot every step regardless).
+
+    ITL is split per phase: ``itl`` stays the combined histogram every
+    existing consumer (SLO controller, bench rows) reads, while
+    ``itl_decode`` holds only gaps between back-to-back decode rounds
+    and ``itl_prefill_gap`` the gaps a prefill (or a KV-chain adoption)
+    interrupted — the head-of-line blocking disaggregation exists to
+    remove, now measurable straight from the registry
+    (``serving/lm/itl_decode`` vs ``serving/lm/itl_prefill_gap``)."""
 
     def __init__(self, slots: int, throughput_window_s: float = 60.0):
         self._lock = threading.Lock()
@@ -194,6 +202,8 @@ class LMMetrics:
         self.spec = None  # SpecMetrics when the engine speculates
         self.ttft = Histogram()
         self.itl = Histogram()
+        self.itl_decode = Histogram()
+        self.itl_prefill_gap = Histogram()
         self.requests = 0
         self.rejected = 0
         self.completed = 0
@@ -211,6 +221,10 @@ class LMMetrics:
                    prefix: str = "serving/lm/") -> "LMMetrics":
         registry.register(prefix + "ttft", self.ttft, replace=True)
         registry.register(prefix + "itl", self.itl, replace=True)
+        registry.register(prefix + "itl_decode", self.itl_decode,
+                          replace=True)
+        registry.register(prefix + "itl_prefill_gap", self.itl_prefill_gap,
+                          replace=True)
         for key in ("requests", "rejected", "completed", "tokens",
                     "prefills", "decode_steps"):
             registry.register(prefix + key,
@@ -245,7 +259,8 @@ class LMMetrics:
             self.ttft.observe(ttft_s)
             self._recent.append((time.perf_counter(), 1))
 
-    def record_step(self, n_active: int, itls_s: Sequence[float]) -> None:
+    def record_step(self, n_active: int, itls_s: Sequence[float],
+                    prefill_interrupted: bool = False) -> None:
         with self._lock:
             now = time.perf_counter()
             self.decode_steps += 1
@@ -257,8 +272,11 @@ class LMMetrics:
             horizon = now - self._window_s
             while self._recent and self._recent[0][0] < horizon:
                 self._recent.popleft()
+            split = (self.itl_prefill_gap if prefill_interrupted
+                     else self.itl_decode)
             for itl in itls_s:
                 self.itl.observe(itl)
+                split.observe(itl)
 
     def record_complete(self) -> None:
         with self._lock:
@@ -289,6 +307,8 @@ class LMMetrics:
                     if self.slot_steps else None,
                 "ttft": self.ttft.snapshot(),
                 "itl": self.itl.snapshot(),
+                "itl_decode": self.itl_decode.snapshot(),
+                "itl_prefill_gap": self.itl_prefill_gap.snapshot(),
                 "spec": (self.spec.snapshot()
                          if self.spec is not None else None),
             }
@@ -339,6 +359,54 @@ class _Slot:
         self.probe_in = 0               # plain rounds until re-probe
 
 
+class KVHandoff:
+    """One request mid-migration between phase replicas.
+
+    The prefill replica builds it after emitting the first token (TTFT
+    belongs to the prefill side); the coordinator fills ``payload``
+    (the exported block-major wire arrays — or None to re-prefill on
+    the decode side) and ``matched`` (blocks the DECODE pool's radix
+    already held for this prompt, retained for the adoption, so prefix
+    sharing survives the hop and only the unmatched tail travels); the
+    decode replica consumes it via :meth:`LMServingEngine.adopt`.
+    Sampling state (``step_keys``, position, last token) crosses intact
+    — the decode side continues the exact offline trajectory."""
+
+    __slots__ = ("stream", "prompt0", "max_new", "temperature", "eos0",
+                 "step_keys", "rid", "first0", "payload", "matched",
+                 "src_name")
+
+    def __init__(self, req: "_Request", first0: int, src_name: str):
+        self.stream = req.stream
+        self.prompt0 = req.prompt0
+        self.max_new = req.max_new
+        self.temperature = req.temperature
+        self.eos0 = req.eos0
+        self.step_keys = req.step_keys
+        self.rid = req.rid
+        self.first0 = int(first0)       # already emitted; never re-emit
+        self.payload = None             # {"k","v","blocks"} wire or None
+        self.matched = []               # decode-pool blocks, pre-retained
+        self.src_name = src_name
+
+
+class _Prefill:
+    """An admitted request's in-progress (possibly chunk-interleaved)
+    prefill: blocks are allocated, ``p`` tokens are in the arena."""
+
+    __slots__ = ("req", "blocks", "slot", "p", "t", "logits", "handoff")
+
+    def __init__(self, req: _Request, blocks: List[int], slot: int,
+                 matched_len: int, handoff: Optional[KVHandoff] = None):
+        self.req = req
+        self.blocks = blocks
+        self.slot = slot
+        self.p = matched_len            # tokens already in the arena
+        self.t = req.prompt0.shape[0]
+        self.logits = None
+        self.handoff = handoff          # set: re-prefill, don't re-emit
+
+
 # ---------------------------------------------------------------------- #
 class LMServingEngine:
     """Serve ``TransformerLM`` generation with continuous batching over
@@ -385,6 +453,26 @@ class LMServingEngine:
             step.  Streams stay bit-exact vs offline generate under the
             default ``"replay"`` acceptance; a per-slot acceptance EMA
             demotes collapsing slots to plain decode and re-probes.
+        max_prefill_chunk_tokens: Sarathi-style chunked-prefill
+            interleaving — when set, the worker advances at most ONE
+            block-aligned chunk of at most this many prompt tokens
+            between decode rounds, so a long prompt landing mid-decode
+            bounds every active stream's inter-token gap at one chunk's
+            prefill instead of the whole prompt.  Trades TTFT for ITL;
+            streams stay token-identical (chunk boundaries only change
+            when KV rows are written, never their values).  Default
+            None keeps the run-to-completion admission prefill.
+        migrate: marks this engine a PREFILL-PHASE replica: after a
+            request's first token is emitted, ``migrate(handoff,
+            blocks, pool)`` is called (in the worker thread; the block
+            chain stays referenced for the duration of the call) and
+            the request leaves this engine — the DisaggCoordinator
+            exports the chain and hands it to a decode replica's
+            :meth:`adopt`.  Mutually exclusive with ``spec``.
+        metrics / metrics_prefix: inject a shared :class:`LMMetrics`
+            (the coordinator aggregates each phase's replicas into one
+            per-phase histogram set for the SLO ladders) and/or publish
+            under a non-default registry prefix.
     """
 
     def __init__(self, model, *,
@@ -405,7 +493,11 @@ class LMServingEngine:
                  name: str = "lm",
                  placement=None,
                  tp_rules=None,
-                 spec=None):
+                 spec=None,
+                 max_prefill_chunk_tokens: Optional[int] = None,
+                 migrate=None,
+                 metrics: Optional[LMMetrics] = None,
+                 metrics_prefix: str = "serving/lm/"):
         select_platform(platform)
         import jax
         from bigdl_tpu.models.transformer.generate import (
@@ -463,6 +555,29 @@ class LMServingEngine:
         # largest bucket; 0 means buckets are sub-block (no chunking)
         self._chunk_full = (self.prefill_buckets[-1]
                             // self.block_len) * self.block_len
+        self.migrate = migrate
+        self.phase = "prefill" if migrate is not None else "colocated"
+        if migrate is not None and spec is not None:
+            raise ValueError(
+                "a prefill-phase replica (migrate=...) cannot speculate: "
+                "it never decodes — speculation belongs on the decode "
+                "replicas")
+        self.max_prefill_chunk_tokens = None
+        self._chunk_cap = None
+        if max_prefill_chunk_tokens is not None:
+            if self._chunk_full == 0:
+                raise ValueError(
+                    "max_prefill_chunk_tokens needs at least one "
+                    f"block-aligned prefill bucket (block_len "
+                    f"{self.block_len}, largest bucket "
+                    f"{self.prefill_buckets[-1]})")
+            self.max_prefill_chunk_tokens = int(max_prefill_chunk_tokens)
+            # chunk boundaries must stay block-aligned so the suffix
+            # prefill's prefix_len is a whole number of blocks
+            self._chunk_cap = max(
+                self.block_len,
+                (self.max_prefill_chunk_tokens
+                 // self.block_len) * self.block_len)
         if num_blocks is None:
             # slots worst-case chains + headroom for radix-held prefixes
             num_blocks = 1 + (self.slots + 4) * self.table_width
@@ -585,13 +700,21 @@ class LMServingEngine:
                 _verify_fn,
                 donate_argnums=(5, 6) if donate_cache else ())
 
-        self.metrics = LMMetrics(self.slots).publish_to(get_registry())
+        self.metrics = (metrics if metrics is not None
+                        else LMMetrics(self.slots)).publish_to(
+            get_registry(), prefix=metrics_prefix)
         self.metrics.spec = self.spec_metrics
         self._publish_kv_metrics(get_registry())
 
         # -- scheduler state (worker thread owns the slots) ------------- #
         self._cv = threading.Condition()
         self._queue: deque = deque()
+        self._adopt_q: deque = deque()       # pending KVHandoff adoptions
+        self._prefilling: deque = deque()    # chunk-interleaved _Prefills
+        self._prefill_since_step = False     # splits the ITL histograms
+        self.migrated = 0       # prefill phase: chains handed off
+        self.adopted = 0        # decode phase: chains seated
+        self.re_prefills = 0    # decode phase: lost payloads recomputed
         # the SLO controller's decode-concurrency actuator: the decode
         # executable always steps the full S physical slots (fixed
         # shape — no recompile), but admission only fills slots up to
@@ -673,7 +796,9 @@ class LMServingEngine:
             # warms its own prefill/decode/insert programs
             self._verify_compiled()
             self.draft.warmup()
-        else:
+        elif self.migrate is None:
+            # a prefill-phase replica never decodes — its requests
+            # migrate after the first token — so skip that compile
             self._decode_compiled()
         for b in self.prefill_buckets:
             self._insert_compiled(b)
@@ -874,6 +999,28 @@ class LMServingEngine:
                             queue_depth=depth)
         return stream
 
+    def adopt(self, handoff: KVHandoff) -> None:
+        """Accept a migrated request (decode-phase entry point): the
+        handoff's KV chain — transferred wire payload plus whatever the
+        local radix already held — is seated into a slot by the worker
+        and decode continues from the token the prefill replica already
+        emitted.  Adoptions outrank queued submissions (they are
+        further along: TTFT is already paid) and defer under pool
+        pressure exactly like admissions."""
+        with self._cv:
+            if self._closing:
+                raise ServingClosed("LMServingEngine is closed")
+            self._adopt_q.append(handoff)
+            self._cv.notify_all()
+        self.metrics.record_submit()
+        if _tracer.sampled(handoff.rid):
+            _tracer.instant("lm/adopt_enqueue", cat="serve",
+                            request_id=handoff.rid,
+                            src=handoff.src_name,
+                            wire_blocks=(handoff.payload["blocks"]
+                                         if handoff.payload else None),
+                            matched_blocks=len(handoff.matched))
+
     # -- live control knobs (the SLO controller's actuators) ----------- #
     def set_slot_limit(self, n: int) -> int:
         """Cap decode concurrency at ``n`` of the S physical slots
@@ -925,20 +1072,49 @@ class LMServingEngine:
         try:
             while True:
                 with self._cv:
-                    while (not self._queue and not self._n_active
+                    while (not self._queue and not self._adopt_q
+                           and not self._n_active and not self._prefilling
                            and not self._closing and not self._abort):
                         self._cv.wait()
                     if self._abort:
                         break
                     if (self._closing and not self._queue
-                            and not self._n_active):
+                            and not self._adopt_q and not self._n_active
+                            and not self._prefilling):
                         return
+                    # in-flight = decoding + mid-prefill: both hold slots
+                    inflight = self._n_active + len(self._prefilling)
+                    adopts = []
+                    # adoptions outrank submissions: their TTFT is paid
+                    while (self._free and self._adopt_q
+                           and (inflight + len(adopts)) < self._slot_limit):
+                        adopts.append((self._free.pop(),
+                                       self._adopt_q.popleft()))
                     admits = []
                     while (self._free and self._queue
-                           and (self._n_active + len(admits))
+                           and (inflight + len(adopts) + len(admits))
                            < self._slot_limit):
                         admits.append((self._free.pop(),
                                        self._queue.popleft()))
+                if self.migrate is not None:
+                    # prefill-phase occupancy: one sample per scheduler
+                    # round (a prefill replica has no decode steps, so
+                    # this is the phase's slot-utilization signal; its
+                    # decode_steps gauge reads as scheduler rounds)
+                    self.metrics.record_step(
+                        min(self.slots,
+                            inflight + len(adopts) + len(admits)), [])
+                deferred_adopts = []
+                for slot, h in adopts:
+                    try:
+                        seated = self._adopt_into(slot, h)
+                    except BaseException as e:  # noqa: BLE001
+                        h.stream._finish(error=e)
+                        with self._cv:
+                            self._free.append(slot)
+                    else:
+                        if not seated:
+                            deferred_adopts.append((slot, h))
                 deferred = []
                 for slot, req in admits:
                     try:
@@ -950,7 +1126,7 @@ class LMServingEngine:
                     else:
                         if not admitted:
                             deferred.append((slot, req))
-                if deferred:
+                if deferred or deferred_adopts:
                     # pool pressure: requeue at the FRONT (FIFO order
                     # preserved) and return the slots — blocks free as
                     # active streams finish, then admission retries
@@ -958,6 +1134,25 @@ class LMServingEngine:
                         for slot, req in reversed(deferred):
                             self._free.append(slot)
                             self._queue.appendleft(req)
+                        for slot, h in reversed(deferred_adopts):
+                            self._free.append(slot)
+                            self._adopt_q.appendleft(h)
+                if self._chunk_cap is not None and self._prefilling:
+                    # Sarathi interleave: ONE bounded chunk of the
+                    # oldest in-progress prefill per scheduler round,
+                    # then back to decoding — the decode stall per
+                    # round is one chunk, not one prompt
+                    pf = self._prefilling[0]
+                    try:
+                        if self._prefill_chunk(pf):
+                            self._prefilling.popleft()
+                            self._finish_prefill(pf)
+                    except BaseException as e:  # noqa: BLE001
+                        self._prefilling.popleft()
+                        self.pool.release(pf.blocks)
+                        pf.req.stream._finish(error=e)
+                        with self._cv:
+                            self._free.append(pf.slot)
                 if self._n_active:
                     if self.draft is not None:
                         self._step_spec()
@@ -1006,11 +1201,100 @@ class LMServingEngine:
                                  req.stream.submitted_at, wait,
                                  cat="serve",
                                  args={"request_id": req.rid, "slot": slot})
+        if self._chunk_cap is not None:
+            # chunk-interleaved mode: allocation happens at admission
+            # (all-or-nothing, same defer semantics), but the prefill
+            # itself advances one bounded chunk per scheduler round in
+            # _run — decode rounds run in between
+            self._prefilling.append(_Prefill(req, blocks, slot,
+                                             len(matched) * B))
+            return True
         try:
             self._prefill_into(req, blocks, slot, len(matched) * B)
         except BaseException:
             self.pool.release(blocks)
             raise
+        return True
+
+    def _adopt_into(self, slot: int, h: KVHandoff) -> bool:
+        """Seat a migrated request into ``slot``: adopt its wire
+        payload into this pool (or re-prefill locally when the payload
+        was lost in transit) and enter decode at the exact position the
+        prefill replica left off.  Returns False (defer) under pool
+        pressure — the handoff's pre-retained ``matched`` blocks stay
+        held across the deferral, same as a matched radix head."""
+        t = h.prompt0.shape[0]
+        B = self.block_len
+        need_total = self.pool.blocks_for(t + h.max_new)
+        if need_total > self.pool.capacity:
+            raise RequestExceedsPool(
+                f"migrated request needs {need_total} blocks; decode "
+                f"pool capacity is {self.pool.capacity}")
+        req = _Request(h.stream, h.prompt0, h.max_new, h.temperature,
+                       h.eos0, None, h.step_keys, h.rid)
+        matched = list(h.matched)
+        if h.payload is None:
+            # wire payload lost (backend_lost at the migrate fault
+            # site): recompute the KV here.  Deterministic prefill ⇒
+            # bit-identical rows; the first token is NOT re-picked or
+            # re-emitted (handoff carries it), so the stream is exact.
+            self.re_prefills += 1
+            n_new = need_total - len(matched)
+            try:
+                fresh = self.pool.alloc(n_new)
+            except PoolExhausted:
+                if self.radix is not None:
+                    self.radix.evict(n_new - self.pool.free_count)
+                try:
+                    fresh = self.pool.alloc(n_new)
+                except PoolExhausted:
+                    return False
+            blocks = matched + fresh
+            pf = _Prefill(req, blocks, slot, len(matched) * B, handoff=h)
+            if self._chunk_cap is not None:
+                self._prefilling.append(pf)
+                return True
+            try:
+                while not self._prefill_chunk(pf):
+                    pass
+                self._finish_prefill(pf)
+            except BaseException:
+                self.pool.release(blocks)
+                raise
+            return True
+        n_wire = int(h.payload["blocks"])
+        extra = need_total - len(matched) - n_wire
+        if extra < 0:
+            raise ValueError(
+                f"wire carries {n_wire} blocks but only "
+                f"{need_total - len(matched)} are unmatched")
+        try:
+            fresh = self.pool.adopt_chain(
+                h.payload["k"], h.payload["v"], extra_blocks=extra,
+                device=self.pool.k.sharding)
+        except PoolExhausted:
+            if self.radix is not None:
+                self.radix.evict(n_wire + extra - self.pool.free_count)
+            try:
+                fresh = self.pool.adopt_chain(
+                    h.payload["k"], h.payload["v"], extra_blocks=extra,
+                    device=self.pool.k.sharding)
+            except PoolExhausted:
+                return False
+        blocks = matched + fresh
+        self.adopted += 1
+        self._prefill_since_step = True  # adoption interrupts decode
+        if self.radix is not None:
+            # cache the adopted prompt for future prefix hits on THIS
+            # pool — sharing survives the hop in both directions
+            nfull = t // B
+            if nfull:
+                self.radix.insert(h.prompt0[:nfull * B], blocks[:nfull])
+        if _tracer.sampled(h.rid):
+            _tracer.instant("lm/adopt", cat="serve", request_id=h.rid,
+                            slot=slot, wire_blocks=n_wire,
+                            matched_blocks=len(matched), src=h.src_name)
+        self._seat(req, t, h.first0, blocks, slot)
         return True
 
     @staticmethod
@@ -1032,57 +1316,85 @@ class LMServingEngine:
                   "emitted": len(stream._tokens)})
 
     def _prefill_into(self, req: _Request, blocks: List[int], slot: int,
-                      matched_len: int) -> None:
-        t = req.prompt0.shape[0]
+                      matched_len: int,
+                      handoff: Optional[KVHandoff] = None) -> None:
+        """Run-to-completion prefill (the non-interleaved path): every
+        chunk back-to-back, then finish."""
+        pf = _Prefill(req, blocks, slot, matched_len, handoff)
+        while not self._prefill_chunk(pf):
+            pass
+        self._finish_prefill(pf)
+
+    def _prefill_chunk(self, pf: _Prefill) -> bool:
+        """One bucketed prefill pass + block scatter; True when the
+        whole prompt is in the arena.  Chunk sizes stay block-aligned
+        (except the final remainder) so the suffix path's prefix_len is
+        always a whole number of blocks; ``max_prefill_chunk_tokens``
+        only lowers the per-chunk ceiling."""
+        req, blocks, t = pf.req, pf.blocks, pf.t
         B = self.block_len
         largest = self.prefill_buckets[-1]
-        p = matched_len
-        logits = None
+        cap = self._chunk_cap
+        largest_eff = largest if cap is None else min(largest, cap)
+        chunk_full = (self._chunk_full if cap is None
+                      else min(self._chunk_full, cap))
+        p = pf.p
         rid_args = ({"request_id": req.rid}
                     if _tracer.sampled(req.rid) else {})
-        while True:
-            rem = t - p
-            ts = rem if rem <= largest else self._chunk_full
-            bucket = self.bucket_for(ts)
-            ids = np.zeros((1, bucket), np.int32)
-            ids[0, :ts] = req.prompt0[p:p + ts]
-            with _tracer.span("lm/prefill", cat="serve", bucket=bucket,
-                              prompt_len=t, prefix_len=p, **rid_args):
-                if p == 0:
-                    logits, k, v = self.prefill_cache(
-                        self._params, self._buffers,
-                        {"ids": ids, "len": np.int32(ts)})
-                else:
-                    nbp = p // B
-                    pb = self._prefix_bucket_for(nbp)
-                    pblocks = np.zeros((pb,), np.int32)
-                    pblocks[:nbp] = blocks[:nbp]
-                    logits, k, v = self.prefix_prefill_cache(
-                        self._params, self._buffers,
-                        {"ids": ids, "len": np.int32(ts),
-                         "prefix_len": np.int32(p), "blocks": pblocks,
-                         "k": self.pool.k, "v": self.pool.v})
-            # scatter the chunk's k/v into its (block-aligned) blocks;
-            # bucket-padding rows land in trailing owned blocks or the
-            # scratch block, always masked until overwritten
-            nb_w = -(-bucket // B)
-            ids_w = np.zeros((nb_w,), np.int32)
-            owned = blocks[p // B:p // B + nb_w]
-            ids_w[:len(owned)] = owned
-            with _tracer.span("lm/insert", cat="serve", slot=slot,
-                              bucket=bucket, **rid_args):
-                self.pool.k, self.pool.v = self._insert_compiled(bucket)(
-                    self.pool.k, self.pool.v, k, v, ids_w)
-            p += ts
-            if p >= t:
-                break
-        logits = np.asarray(logits)  # sync; (1, V) f32
+        rem = t - p
+        ts = rem if rem <= largest_eff else chunk_full
+        bucket = self.bucket_for(ts)
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :ts] = req.prompt0[p:p + ts]
+        with _tracer.span("lm/prefill", cat="serve", bucket=bucket,
+                          prompt_len=t, prefix_len=p, **rid_args):
+            if p == 0:
+                logits, k, v = self.prefill_cache(
+                    self._params, self._buffers,
+                    {"ids": ids, "len": np.int32(ts)})
+            else:
+                nbp = p // B
+                pb = self._prefix_bucket_for(nbp)
+                pblocks = np.zeros((pb,), np.int32)
+                pblocks[:nbp] = blocks[:nbp]
+                logits, k, v = self.prefix_prefill_cache(
+                    self._params, self._buffers,
+                    {"ids": ids, "len": np.int32(ts),
+                     "prefix_len": np.int32(p), "blocks": pblocks,
+                     "k": self.pool.k, "v": self.pool.v})
+        # scatter the chunk's k/v into its (block-aligned) blocks;
+        # bucket-padding rows land in trailing owned blocks or the
+        # scratch block, always masked until overwritten
+        nb_w = -(-bucket // B)
+        ids_w = np.zeros((nb_w,), np.int32)
+        owned = blocks[p // B:p // B + nb_w]
+        ids_w[:len(owned)] = owned
+        with _tracer.span("lm/insert", cat="serve", slot=pf.slot,
+                          bucket=bucket, **rid_args):
+            self.pool.k, self.pool.v = self._insert_compiled(bucket)(
+                self.pool.k, self.pool.v, k, v, ids_w)
+        self._prefill_since_step = True
+        pf.logits = logits
+        pf.p = p + ts
+        return pf.p >= t
+
+    def _finish_prefill(self, pf: _Prefill) -> None:
+        req, blocks, slot, t = pf.req, pf.blocks, pf.slot, pf.t
+        B = self.block_len
         # cache the prompt's full blocks for future prefix hits (the
         # matched head is already in the trie; only novel tails add)
         if self.radix is not None:
             nfull = t // B
             if nfull:
                 self.radix.insert(req.prompt0[:nfull * B], blocks[:nfull])
+        if pf.handoff is not None:
+            # re-prefill of a migrated request whose wire payload was
+            # lost: the first token was already emitted on the prefill
+            # replica — recompute the KV rows, discard the logits, and
+            # seat decode exactly where the handoff says it stands
+            self._seat(req, t, pf.handoff.first0, blocks, slot)
+            return
+        logits = np.asarray(pf.logits)  # sync; (1, V) f32
         first0 = self._pick(logits[0], req.temperature, req.first_key,
                             clamp=False)
         req.stream._emit(first0 + 1)
@@ -1097,6 +1409,31 @@ class LMServingEngine:
             with self._cv:
                 self._free.append(slot)
             return
+        if self.migrate is not None:
+            # prefill-phase replica: the chain + sampling state hop to
+            # a decode replica; this engine's slot and blocks free as
+            # soon as the coordinator is done with them (the callback
+            # runs with our references still held)
+            h = KVHandoff(req, first0, self.name)
+            try:
+                with _tracer.span("lm/migrate", cat="serve",
+                                  prompt_len=t,
+                                  **({"request_id": req.rid}
+                                     if _tracer.sampled(req.rid) else {})):
+                    self.migrate(h, blocks, self.pool)
+                self.migrated += 1
+            except BaseException as e:  # noqa: BLE001
+                req.stream._finish(error=e)
+                self._trace_done(req.stream, req.rid)
+            finally:
+                self.pool.release(blocks)
+                with self._cv:
+                    self._free.append(slot)
+            return
+        self._seat(req, t, first0, blocks, slot)
+
+    def _seat(self, req: _Request, t: int, first0: int,
+              blocks: List[int], slot: int) -> None:
         table = np.zeros((self.table_width,), np.int32)
         table[:len(blocks)] = blocks
         st = _Slot(req, t, first0, blocks, table)
@@ -1164,7 +1501,9 @@ class LMServingEngine:
                 st.stream._finish()
                 self.metrics.record_complete()
                 freed.append(i)
-        self.metrics.record_step(len(active), itls)
+        self.metrics.record_step(len(active), itls,
+                                 prefill_interrupted=self._prefill_since_step)
+        self._prefill_since_step = False
         if freed:
             with self._cv:
                 for i in freed:
@@ -1338,7 +1677,9 @@ class LMServingEngine:
                     self.draft.push(i, emitted[0])
         self.spec_metrics.record_verify_round(
             bool(jobs), n_emitted, self.draft.steps - steps_before)
-        self.metrics.record_step(len(active), itls)
+        self.metrics.record_step(len(active), itls,
+                                 prefill_interrupted=self._prefill_since_step)
+        self._prefill_since_step = False
         if freed:
             with self._cv:
                 for i in freed:
@@ -1356,6 +1697,16 @@ class LMServingEngine:
         with self._cv:
             pending = [r.stream for r in self._queue]
             self._queue.clear()
+            pending.extend(h.stream for h in self._adopt_q)
+            for h in self._adopt_q:
+                if h.matched:
+                    self.pool.release(h.matched)
+            self._adopt_q.clear()
+            for pf in self._prefilling:
+                pending.append(pf.req.stream)
+                self.pool.release(pf.blocks)
+                self._free.append(pf.slot)
+            self._prefilling.clear()
             for i, st in enumerate(self._slots):
                 if st is not None:
                     pending.append(st.stream)
@@ -1390,6 +1741,8 @@ class LMServingEngine:
             active = self._n_active
             slot_limit = self._slot_limit
             max_queue = self._max_queue
+            prefilling = len(self._prefilling)
+            adopt_q = len(self._adopt_q)
         return {
             "name": self.name,
             "slots": self.slots,
@@ -1397,6 +1750,13 @@ class LMServingEngine:
             "max_queue": max_queue,
             "active": active,
             "queued": queued,
+            "phase": self.phase,
+            "prefilling": prefilling,
+            "adopt_queue": adopt_q,
+            "max_prefill_chunk_tokens": self._chunk_cap,
+            "migrated": self.migrated,
+            "adopted": self.adopted,
+            "re_prefills": self.re_prefills,
             "cache_len": self.cache_len,
             "block_len": self.block_len,
             "decode_attn": self.decode_attn,

@@ -61,6 +61,7 @@ if [ "$SMOKE" = "1" ]; then
   SERVE_LM_ARGS="--requests 6 --slots 2 --cache-len 64 --mean-gap-ms 5 --probes 1"
   SPEC_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1"
   PREFIX_ARGS="--requests 6 --slots 2 --cache-len 96 --shared-len 32 --mean-gap-ms 5 --probes 1"
+  DISAGG_ARGS="--requests 8 --slots 4 --cache-len 128 --chunk-tokens 16 --mean-gap-ms 5 --probes 1"
   SLO_ARGS="--loads 4,8 --duration 1.5 --chaos-duration 2 --chaos-rps 15 --slots 2 --cache-len 64"
   MESH_ARGS="--requests 8 --batch 4"
 else
@@ -82,6 +83,7 @@ else
   SERVE_LM_ARGS="--requests 48 --slots 8 --cache-len 128"
   SPEC_ARGS="--requests 24 --slots 8 --cache-len 128"
   PREFIX_ARGS="--requests 24 --slots 8 --cache-len 128 --shared-len 64"
+  DISAGG_ARGS="--requests 24 --slots 8 --cache-len 128 --chunk-tokens 32"
   SLO_ARGS="--loads 4,8,16,32,64 --duration 5 --chaos-duration 8"
   MESH_ARGS="--requests 48 --batch 8"
 fi
@@ -119,7 +121,7 @@ PYEOF
 ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json TUNE_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
 BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json BENCH_MESH.json \
-BENCH_SPEC.json \
+BENCH_SPEC.json BENCH_DISAGG.json \
 FLIGHT_*.json TRACE_*.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
@@ -349,6 +351,26 @@ prefix_stage() {
   return 1
 }
 
+# disagg rides right after prefix: same decode hot path plus the
+# KV-chain migration plane (block-major export/adopt over the chunked
+# transfer path, itself pinned below the 32 MB relay ceiling), and the
+# chunked-prefill interleave.  Same ok_lm gate — the repo ships a
+# CPU-proven BENCH_DISAGG.json, which must never mark the TPU stage
+# done — and the same never-gates-the-round contract.
+disagg_stage() {
+  ok_lm BENCH_DISAGG.json && return 0
+  say "stage disagg: firing (budget 600s): python -u bench.py --serve-lm --disagg $DISAGG_ARGS"
+  timeout 600 python -u bench.py --serve-lm --disagg $DISAGG_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm BENCH_DISAGG.json; then
+    say "stage disagg: DONE"
+    return 0
+  fi
+  say "stage disagg: not done (rc=$rc)"
+  record_incident disagg "$rc"
+  return 1
+}
+
 # slo rides right after serve-lm: the traffic harness sweeps offered
 # load over the same decode hot path and replays the round's OWN
 # incident log (TUNNEL_INCIDENTS.json) as mid-load chaos.  Same
@@ -440,6 +462,7 @@ while :; do
     spec_stage
     mesh_stage
     prefix_stage
+    disagg_stage
     slo_stage
     # dispatch-overhead experiment: same step, SCAN_STEPS per device
     # call (the scan variant never writes BENCH_LAST — different

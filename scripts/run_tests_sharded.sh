@@ -17,6 +17,9 @@ N=${N:-3}
 # test_spec_decode.py carries the serving.verify site (a transient
 # demotes speculating slots instead of killing streams) and the
 # acceptance-collapse demotion matrix.
+# test_disagg.py carries the serving.migrate site (a transient retries
+# the KV-chain export; a lost payload re-prefills on the decode
+# replica — zero accepted-request loss either way).
 # Observability gate first (OBS_GATE=0 skips): tracing, the metric
 # registry, the telemetry sampler, and the flight recorder are the
 # instruments every OTHER failure is diagnosed with — a broken
@@ -29,7 +32,8 @@ fi
 
 if [ "${FAULTS_GATE:-1}" = "1" ]; then
   python -m pytest tests/test_resilience.py tests/test_traffic.py \
-    tests/test_kvcache.py tests/test_spec_decode.py -q -m faults || exit 1
+    tests/test_kvcache.py tests/test_spec_decode.py tests/test_disagg.py \
+    -q -m faults || exit 1
 fi
 
 # Artifact schema lint: committed BENCH_*/TUNE_*/PROFILE_*/TRACE_*/

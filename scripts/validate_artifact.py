@@ -143,6 +143,55 @@ def _spec_problems(doc) -> list:
     return probs
 
 
+def _disagg_problems(doc) -> list:
+    """BENCH_DISAGG.json extras: the disaggregated-serving proof is an
+    AGREEMENT artifact — every stage must stream the exact co-located
+    trajectory (agreement == 1.0) or the latency numbers are comparing
+    different computations.  A complete doc must also carry the per-stage
+    tail latencies the round-end driver reads."""
+    probs = []
+    if doc.get("error"):
+        return probs
+    for i, r in enumerate(doc.get("rows", [])):
+        if not isinstance(r, dict):
+            continue
+        if "stage" not in r:
+            probs.append("disagg row %d lacks a 'stage' key" % i)
+        if doc.get("complete") is True:
+            if r.get("agreement") != 1.0:
+                probs.append("complete disagg artifact: row %d (%s) "
+                             "agreement must be exactly 1.0, got %r"
+                             % (i, r.get("stage"), r.get("agreement")))
+            if not isinstance(r.get("itl_p99_ms"), (int, float)):
+                probs.append("complete disagg artifact: row %d (%s) "
+                             "lacks numeric itl_p99_ms"
+                             % (i, r.get("stage")))
+            ttft = r.get("ttft")
+            if (not isinstance(ttft, dict)
+                    or not isinstance(ttft.get("p99_ms"), (int, float))):
+                probs.append("complete disagg artifact: row %d (%s) "
+                             "lacks numeric ttft.p99_ms"
+                             % (i, r.get("stage")))
+    if doc.get("complete") is True:
+        summ = doc.get("summary")
+        if not isinstance(summ, dict):
+            probs.append("complete disagg artifact lacks a summary")
+            return probs
+        for key in ("itl_p99_ms", "ttft_p99_ms", "agreement"):
+            if not isinstance(summ.get(key), dict):
+                probs.append("complete disagg artifact: summary.%s "
+                             "must map stage -> value" % key)
+        ags = summ.get("agreement")
+        if isinstance(ags, dict) and any(v != 1.0 for v in ags.values()):
+            probs.append("complete disagg artifact: summary.agreement "
+                         "must be exactly 1.0 for every stage, got %r"
+                         % (ags,))
+        if summ.get("chaos_zero_accepted_loss") is not True:
+            probs.append("complete disagg artifact: "
+                         "summary.chaos_zero_accepted_loss must be true")
+    return probs
+
+
 def _problems(doc, name: str = "") -> list:
     """Contract violations for one parsed artifact document."""
     probs = []
@@ -174,6 +223,8 @@ def _problems(doc, name: str = "") -> list:
             probs.extend(_mesh_problems(doc))
         if name == "BENCH_SPEC.json":
             probs.extend(_spec_problems(doc))
+        if name == "BENCH_DISAGG.json":
+            probs.extend(_disagg_problems(doc))
         return probs
     if "metric" not in doc:
         probs.append("no 'rows', no supervisor record, no 'metric' key "
