@@ -1461,6 +1461,9 @@ def _serve_lm_spec_bench(argv) -> int:
     ap.add_argument("--probes", type=int, default=2,
                     help="requests probed for bit-exactness vs offline "
                          "generate (both stages; spec must score 1.0)")
+    ap.add_argument("--drafter-compute", default="dequant",
+                    choices=("dequant", "int8", "auto"),
+                    help="kernel regime for the int8 drafter clone")
     args = ap.parse_args(argv)
     if args.json is None:
         args.json = os.path.join(
@@ -1484,6 +1487,7 @@ def _serve_lm_spec_bench(argv) -> int:
               "decode_attn": "gather",
               "spec_k": args.spec_k, "sampling": "replay",
               "drafter": "int8_clone",
+              "drafter_compute": args.drafter_compute,
               "requests": args.requests,
               "mean_gap_ms": args.mean_gap_ms,
               "prompt_lens": list(_LM_PROMPT_LENS),
@@ -1516,7 +1520,9 @@ def _serve_lm_spec_bench(argv) -> int:
                               cache_len=args.cache_len,
                               block_len=args.block_len,
                               max_queue=max(args.requests, 256),
-                              spec=SpecConfig(k=args.spec_k),
+                              spec=SpecConfig(
+                                  k=args.spec_k,
+                                  drafter_compute=args.drafter_compute),
                               name="lm-spec")
         try:
             t0 = time.perf_counter()
@@ -1530,6 +1536,8 @@ def _serve_lm_spec_bench(argv) -> int:
                                      else None)
             row["drafted"] = spec["drafted"]
             row["demotions"] = spec["demotions"]
+            row["drafter_compute"] = spec.get("compute_mode")
+            row["overflow_risk"] = spec.get("overflow_risk")
             row["verify_compiles"] = eng._verify_compiles
             row["draft_decode_compiles"] = eng.draft.decode_compiles
             return row
@@ -1588,6 +1596,256 @@ def _serve_lm_spec_bench(argv) -> int:
         "unit": "tokens/sec", "platform": platform,
         **{k: v for k, v in result["summary"].items()
            if k != "tokens_per_s"}}), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# --serve-lm --spec --qcompute: int8-compute drafter duel -> BENCH_QCOMPUTE.json
+# ---------------------------------------------------------------------------
+
+def _serve_lm_qcompute_bench(argv) -> int:
+    """True int8-compute benchmark -> BENCH_QCOMPUTE.json.
+
+    Two measurement families in one resumable artifact:
+
+    1. **duel rows** (``duel:{impl}:{m}x{k}x{n}``): the int8-compute vs
+       dequant-bf16 matmul duel at drafter-relevant shapes, run through
+       ``ops.autotune.autotune_qcompute`` so the verdicts ALSO persist
+       in the shared tuning cache — which is what makes the
+       ``spec_auto`` stage's ``compute="auto"`` honor the measured
+       winner instead of guessing.
+    2. **serving stages** (``spec_dequant`` / ``spec_int8`` /
+       ``spec_auto`` / ``baseline``): one arrival trace replayed
+       through spec engines whose drafter runs each kernel regime,
+       plus the plain no-spec engine.  Replay acceptance makes every
+       spec stream the offline trajectory bit-for-bit REGARDLESS of
+       drafter numerics (the drafter only moves the acceptance rate),
+       so the artifact certifies only when every spec stage's
+       agreement is exactly 1.0 AND the int8 drafter's overhead
+       (drafter steps per emitted token) stays within 0.02 of the
+       dequant drafter's.
+
+    Same resumable-artifact contract as every bench: a row per stage,
+    flushed as it lands, ``complete: false`` until the final gate."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --serve-lm --spec "
+                                      "--qcompute")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--requests", type=int, default=int(
+        os.environ.get("BIGDL_TPU_SERVE_LM_REQUESTS", "24")))
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--cache-len", type=int, default=128)
+    ap.add_argument("--block-len", type=int, default=16)
+    ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument("--mean-gap-ms", type=float, default=15.0)
+    ap.add_argument("--probes", type=int, default=2,
+                    help="requests probed for bit-exactness vs offline "
+                         "generate (every spec stage must score 1.0)")
+    ap.add_argument("--duel-iters", type=int, default=20)
+    args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_QCOMPUTE.json")
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import jax
+    import numpy as np
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.ops import autotune
+    from bigdl_tpu.serving import LMServingEngine, SpecConfig
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    device_kind = jax.devices()[0].device_kind
+    hidden, ffn = 128, 512
+    # the drafter's actual matmul shapes: decode rows are (slots, hidden)
+    # against the attention projections and the MLP up/down weights
+    duel_shapes = [(args.slots, hidden, hidden),
+                   (args.slots, hidden, ffn),
+                   (args.slots, ffn, hidden)]
+    config = {"model": "transformer_lm", "vocab": 256, "hidden": hidden,
+              "heads": 4, "layers": 4, "max_len": args.cache_len,
+              "pos": "rope", "slots": args.slots,
+              "cache_len": args.cache_len,
+              "layout": "paged", "block_len": args.block_len,
+              "decode_attn": "gather",
+              "spec_k": args.spec_k, "sampling": "replay",
+              "drafter": "int8_clone",
+              "requests": args.requests,
+              "mean_gap_ms": args.mean_gap_ms,
+              "duel_shapes": [list(s) for s in duel_shapes],
+              "duel_iters": args.duel_iters,
+              "prompt_lens": list(_LM_PROMPT_LENS),
+              "max_news": list(_LM_MAX_NEWS)}
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "lm_serving_qcompute",
+              "platform": platform, "device_kind": device_kind,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+
+    # -- 1. the duel (through the shared tuning cache) ------------------- #
+    duel_keys = ["duel:%s:%dx%dx%d" % (impl, m, k, n)
+                 for m, k, n in duel_shapes
+                 for impl in ("int8_compute", "dequant_bf16")]
+    if all(key in prev for key in duel_keys):
+        for key in duel_keys:
+            row = dict(prev[key])
+            row["reused_from_previous_run"] = True
+            rows.append(row)
+        flush()
+    else:
+        # autotune_qcompute is itself resumable against the TUNE doc,
+        # so a re-run only re-measures what the cache does not cover
+        tune_doc = autotune.autotune_qcompute(
+            duel_shapes, iters=args.duel_iters,
+            log=lambda m: print("bench: %s" % m, flush=True))
+        by_key = {}
+        for r in tune_doc.get("rows") or []:
+            if r.get("kind") == "qcompute" and "step_s" in r:
+                by_key["duel:%s:%dx%dx%d" % (r["impl"], r["m"], r["k"],
+                                             r["n"])] = r
+        for key in duel_keys:
+            r = by_key.get(key)
+            if r is None:
+                print(f"bench: duel row {key} failed to measure; "
+                      "artifact left incomplete", file=sys.stderr)
+                flush()
+                return 1
+            rows.append({"stage": key, "impl": r["impl"], "m": r["m"],
+                         "k": r["k"], "n": r["n"],
+                         "step_s": r["step_s"],
+                         "tokens_per_s": r.get("tokens_per_s")})
+            flush()
+    # verdicts the spec_auto stage will trace against
+    auto_verdicts = {
+        "%dx%dx%d" % (m, k, n): autotune.lookup_qcompute(m, k, n)
+        for m, k, n in duel_shapes}
+
+    # -- 2. the serving stages ------------------------------------------- #
+    model = TransformerLM(
+        vocab_size=config["vocab"], hidden_size=hidden,
+        n_head=config["heads"], n_layers=config["layers"],
+        max_len=args.cache_len, pos_encoding="rope").build(seed=7)
+    work = _lm_workload(args.requests, config["vocab"],
+                        args.mean_gap_ms, np.random.RandomState(0))
+
+    def _spec_stage(compute):
+        eng = LMServingEngine(model, slots=args.slots,
+                              cache_len=args.cache_len,
+                              block_len=args.block_len,
+                              max_queue=max(args.requests, 256),
+                              spec=SpecConfig(k=args.spec_k,
+                                              drafter_compute=compute),
+                              name="lm-q-%s" % compute)
+        try:
+            t0 = time.perf_counter()
+            eng.warmup()
+            warm_s = round(time.perf_counter() - t0, 3)
+            row = _serve_lm_stage_continuous(eng, model, work, args.probes)
+            row["warmup_s"] = warm_s
+            spec = eng.stats()["spec"]
+            row["drafter_compute"] = spec.get("compute_mode")
+            row["overflow_risk"] = spec.get("overflow_risk")
+            row["draft_overhead"] = (round(spec["draft_overhead"], 4)
+                                     if spec["draft_overhead"] is not None
+                                     else None)
+            row["drafted"] = spec["drafted"]
+            row["demotions"] = spec["demotions"]
+            if compute == "auto":
+                row["auto_verdicts"] = auto_verdicts
+            return row
+        finally:
+            eng.close()
+
+    def _plain_stage():
+        eng = LMServingEngine(model, slots=args.slots,
+                              cache_len=args.cache_len,
+                              block_len=args.block_len,
+                              max_queue=max(args.requests, 256),
+                              name="lm-q-plain")
+        try:
+            eng.warmup()
+            return _serve_lm_stage_continuous(eng, model, work, args.probes)
+        finally:
+            eng.close()
+
+    stages = {"spec_dequant": lambda: _spec_stage("dequant"),
+              "spec_int8": lambda: _spec_stage("int8"),
+              "spec_auto": lambda: _spec_stage("auto"),
+              "baseline": _plain_stage}
+    for name, run in stages.items():
+        if name in prev:
+            row = dict(prev[name])
+            row["reused_from_previous_run"] = True
+        else:
+            row = {"stage": name, **run()}
+        rows.append(row)
+        flush()
+
+    by_stage = {r["stage"]: r for r in rows if "stage" in r}
+    spec_stages = ("spec_dequant", "spec_int8", "spec_auto")
+    # gate 1: replay exactness — drafter numerics must never reach the
+    # emitted stream, whatever kernels it runs
+    if args.probes:
+        for name in spec_stages:
+            if by_stage[name]["agreement"] != 1.0:
+                print(f"bench: {name} AGREEMENT "
+                      f"{by_stage[name]['agreement']} != 1.0 — spec "
+                      "streams diverged from offline generate; artifact "
+                      "left incomplete", file=sys.stderr)
+                flush()
+                return 1
+    # gate 2: the int8 drafter earns its keep — drafter steps per
+    # emitted token no worse than the dequant drafter's (PR 10 baseline
+    # reference: acceptance 0.9867, draft_overhead 0.16)
+    ov_dq = by_stage["spec_dequant"].get("draft_overhead")
+    ov_i8 = by_stage["spec_int8"].get("draft_overhead")
+    if ov_dq is not None and ov_i8 is not None and ov_i8 > ov_dq + 0.02:
+        print(f"bench: int8 drafter overhead {ov_i8} exceeds dequant "
+              f"{ov_dq} + 0.02 — acceptance collapsed under activation "
+              "quantization; artifact left incomplete", file=sys.stderr)
+        flush()
+        return 1
+
+    base = by_stage["baseline"]
+    result["summary"] = {
+        "tokens_per_s_int8": by_stage["spec_int8"]["tokens_per_s"],
+        "tokens_per_s_dequant": by_stage["spec_dequant"]["tokens_per_s"],
+        "tokens_per_s_auto": by_stage["spec_auto"]["tokens_per_s"],
+        "baseline_tokens_per_s": base["tokens_per_s"],
+        "acceptance_int8": by_stage["spec_int8"]["accept_rate"],
+        "acceptance_dequant": by_stage["spec_dequant"]["accept_rate"],
+        "draft_overhead_int8": ov_i8,
+        "draft_overhead_dequant": ov_dq,
+        "draft_overhead_ref_pr10": 0.16,
+        "overflow_risk": by_stage["spec_int8"].get("overflow_risk"),
+        "agreement": 1.0 if args.probes else None,
+        "auto_verdicts": auto_verdicts,
+        "spec_k": args.spec_k,
+    }
+    result["complete"] = True
+    flush()
+    print(json.dumps({
+        "metric": "lm_serving_qcompute_tokens_per_sec",
+        "value": by_stage["spec_int8"]["tokens_per_s"],
+        "unit": "tokens/sec", "platform": platform,
+        **{k: v for k, v in result["summary"].items()
+           if k not in ("tokens_per_s_int8",)}}), flush=True)
     return 0
 
 
@@ -2480,6 +2738,10 @@ if __name__ == "__main__":
         sys.exit(_serve_lm_disagg_bench(
             [a for a in sys.argv[1:]
              if a not in ("--serve-lm", "--disagg")]))
+    if "--serve-lm" in sys.argv and "--qcompute" in sys.argv:
+        sys.exit(_serve_lm_qcompute_bench(
+            [a for a in sys.argv[1:]
+             if a not in ("--serve-lm", "--spec", "--qcompute")]))
     if "--serve-lm" in sys.argv and "--spec" in sys.argv:
         sys.exit(_serve_lm_spec_bench(
             [a for a in sys.argv[1:]

@@ -153,8 +153,9 @@ class TransformerLM(Module):
             return switch_mlp(bp["moe"], m,
                               capacity_factor=self.moe_capacity_factor,
                               balance_axis=self.moe_balance_axis)
-        m = jax.nn.gelu(m @ bp["w1"] + bp["b1"], approximate=True)
-        return m @ bp["w2"] + bp["b2"], jnp.zeros((), jnp.float32)
+        from bigdl_tpu.quant.kernels import qmatmul
+        m = jax.nn.gelu(qmatmul(m, bp["w1"]) + bp["b1"], approximate=True)
+        return qmatmul(m, bp["w2"]) + bp["b2"], jnp.zeros((), jnp.float32)
 
     def init(self, rng):
         k_emb, k_pos, k_head, k_blocks = jax.random.split(rng, 4)
@@ -245,9 +246,14 @@ class TransformerLM(Module):
                                        positions, segment_ids),
             h, (params["blocks"], keys))
         h = self._layer_norm(params["ln_f"], h)
-        head = (params["embed"].T.astype(h.dtype) if self.tie_embeddings
-                else params["head"].astype(h.dtype))
-        logits = h @ head
+        if self.tie_embeddings:
+            logits = h @ params["embed"].T.astype(h.dtype)
+        else:
+            from bigdl_tpu.quant import is_qtensor
+            from bigdl_tpu.quant.kernels import qmatmul
+            head = params["head"]
+            logits = (qmatmul(h, head) if is_qtensor(head)
+                      else h @ head.astype(h.dtype))
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         return logp, jnp.sum(auxes)
 

@@ -37,6 +37,21 @@ def _block_qkv(model, bp, h):
     return model._mha.project_qkv(bp["attn"], a, a, a)
 
 
+def _head_logits(model, params, h):
+    """LM-head matmul shared by every decode/prefill/verify path —
+    QTensor-aware so an int8-compute drafter's untied head runs on the
+    int8 MXU path (tied heads ride the f32 embedding, which the quant
+    policy never touches)."""
+    from bigdl_tpu.quant import is_qtensor
+    from bigdl_tpu.quant.kernels import qmatmul
+    if model.tie_embeddings:
+        return h @ params["embed"].T.astype(h.dtype)
+    head = params["head"]
+    if is_qtensor(head):
+        return qmatmul(h, head)
+    return h @ head.astype(h.dtype)
+
+
 def _finish_block(model, bp, h, o):
     h = h + model._mha.project_out(bp["attn"], o)
     m = model._layer_norm(bp["ln2"], h)
@@ -93,9 +108,7 @@ def _prefill_parts(model, params, ids0, last_index):
     h, (k, v) = lax.scan(body, h, params["blocks"])
     h = lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)
     h = model._layer_norm(params["ln_f"], h)
-    head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
-            else params["head"].astype(h.dtype))
-    logits = (h @ head)[:, 0]
+    logits = _head_logits(model, params, h)[:, 0]
     return logits.astype(jnp.float32), k, v
 
 
@@ -151,14 +164,25 @@ def _decode_step_slots(model, params, token, pos, k_cache, v_cache):
     h, (k_cache, v_cache) = lax.scan(body, h,
                                      (params["blocks"], k_cache, v_cache))
     h = model._layer_norm(params["ln_f"], h)
-    head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
-            else params["head"].astype(h.dtype))
-    logits = (h @ head)[:, 0]
+    logits = _head_logits(model, params, h)[:, 0]
     return logits.astype(jnp.float32), k_cache, v_cache
 
 
+def _kv_quantize_rows(x):
+    """Symmetric int8 rows for the quantized KV arenas: ``x`` (..., D)
+    float -> (q int8 (..., D), scale f32 (...,)) with per-row absmax
+    scales (one scale per (position, head) row — the granularity the
+    paged gather can rescale for free)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    s = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
 def _prefill_suffix_parts(model, params, ids0, last_index, prefix_len,
-                          blocks, k_arena, v_arena):
+                          blocks, k_arena, v_arena,
+                          k_scale=None, v_scale=None):
     """Prefill a prompt SUFFIX against a cached prefix held in paged KV
     blocks: ``ids0`` (1, Ts) is the (bucket-padded) suffix, whose tokens
     live at absolute positions ``prefix_len + i``; ``blocks`` (Pb,) is
@@ -172,7 +196,12 @@ def _prefill_suffix_parts(model, params, ids0, last_index, prefix_len,
     valid key set (cached prefix keys — stored post-RoPE, so directly
     reusable — plus causal suffix keys) through the same
     ``dot_product_attention`` core, with padded/garbage keys masked to
-    the same NEG_INF before the max-subtracted softmax."""
+    the same NEG_INF before the max-subtracted softmax.
+
+    ``k_scale``/``v_scale`` (L, N, H, B) f32 mark int8-quantized arenas
+    (``BlockPool(kv_quant="int8")``): the prefix gather dequantizes
+    in-flight (int8 block x per-row scale); the returned suffix k/v stay
+    full precision — the engine quantizes them at ``_insert_blocks``."""
     from bigdl_tpu.nn.attention import dot_product_attention
 
     b, ts = ids0.shape
@@ -192,38 +221,53 @@ def _prefill_suffix_parts(model, params, ids0, last_index, prefix_len,
     mask = ((jk < prefix_len)
             | ((jk >= pb * B) & (jk - pb * B <= jq)))[None, None]
 
+    quantized = k_scale is not None
+
     def body(h, layer):
-        bp, kc, vc = layer          # kc/vc: (N, H, B, D) one layer
+        if quantized:
+            bp, kc, vc, ks, vs = layer
+        else:
+            bp, kc, vc = layer      # kc/vc: (N, H, B, D) one layer
         q, k, v = _block_qkv(model, bp, h)
         q, k = model._rope(q, k, positions)
         # gather the prefix chain: (Pb, H, B, D) -> (1, H, Pb*B, D)
-        kp = kc[blocks].transpose(1, 0, 2, 3).reshape(
-            1, kc.shape[1], pb * B, kc.shape[3])
-        vp = vc[blocks].transpose(1, 0, 2, 3).reshape(
-            1, vc.shape[1], pb * B, vc.shape[3])
+        kp = kc[blocks]
+        vp = vc[blocks]
+        if quantized:               # dequant inside the gather
+            kp = kp.astype(jnp.float32) * ks[blocks][..., None]
+            vp = vp.astype(jnp.float32) * vs[blocks][..., None]
+        kp = kp.transpose(1, 0, 2, 3).reshape(
+            1, kc.shape[1], pb * B, kc.shape[3]).astype(k.dtype)
+        vp = vp.transpose(1, 0, 2, 3).reshape(
+            1, vc.shape[1], pb * B, vc.shape[3]).astype(v.dtype)
         o = dot_product_attention(q, jnp.concatenate([kp, k], axis=2),
                                   jnp.concatenate([vp, v], axis=2),
                                   mask=mask)
         h = _finish_block(model, bp, h, o)
         return h, (k, v)
 
-    h, (k, v) = lax.scan(body, h, (params["blocks"], k_arena, v_arena))
+    xs = ((params["blocks"], k_arena, v_arena, k_scale, v_scale)
+          if quantized else (params["blocks"], k_arena, v_arena))
+    h, (k, v) = lax.scan(body, h, xs)
     h = lax.dynamic_slice_in_dim(h, last_index, 1, axis=1)
     h = model._layer_norm(params["ln_f"], h)
-    head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
-            else params["head"].astype(h.dtype))
-    logits = (h @ head)[:, 0]
+    logits = _head_logits(model, params, h)[:, 0]
     return logits.astype(jnp.float32), k, v
 
 
-def _insert_blocks(k_arena, v_arena, k_new, v_new, block_ids):
+def _insert_blocks(k_arena, v_arena, k_new, v_new, block_ids,
+                   k_scale=None, v_scale=None):
     """Scatter a prefilled chunk's k/v (L, 1, H, Tb, D) into arena
     blocks (L, N, H, B, D): row i of the chunk lands in block
     ``block_ids[i // B]`` at offset ``i % B`` (chunks always start
     block-aligned).  ``block_ids`` is padded to ``ceil(Tb_bucket / B)``
     with the scratch block, which absorbs the bucket-padding garbage —
     by the time any real position in those rows is attended, decode has
-    overwritten it under the position mask."""
+    overwritten it under the position mask.
+
+    With ``k_scale``/``v_scale`` (L, N, H, B) f32 (int8-quantized pool)
+    the chunk rows are quantized per (position, head) on the way in and
+    the scale arenas are scattered alongside; returns a 4-tuple then."""
     L, N, H, B, D = k_arena.shape
     nb = block_ids.shape[0]
     tb = k_new.shape[3]
@@ -232,6 +276,18 @@ def _insert_blocks(k_arena, v_arena, k_new, v_new, block_ids):
         padw = ((0, 0), (0, 0), (0, 0), (0, pad), (0, 0))
         k_new = jnp.pad(k_new, padw)
         v_new = jnp.pad(v_new, padw)
+    if k_scale is not None:
+        kq, ksr = _kv_quantize_rows(k_new[:, 0])     # (L, H, nb*B, D/-)
+        vq, vsr = _kv_quantize_rows(v_new[:, 0])
+        kb = kq.reshape(L, H, nb, B, D).transpose(0, 2, 1, 3, 4)
+        vb = vq.reshape(L, H, nb, B, D).transpose(0, 2, 1, 3, 4)
+        ksb = ksr.reshape(L, H, nb, B).transpose(0, 2, 1, 3)
+        vsb = vsr.reshape(L, H, nb, B).transpose(0, 2, 1, 3)
+        k_arena = k_arena.at[:, block_ids].set(kb)
+        v_arena = v_arena.at[:, block_ids].set(vb)
+        k_scale = k_scale.at[:, block_ids].set(ksb)
+        v_scale = v_scale.at[:, block_ids].set(vsb)
+        return k_arena, v_arena, k_scale, v_scale
     kb = k_new[:, 0].reshape(L, H, nb, B, D).transpose(0, 2, 1, 3, 4)
     vb = v_new[:, 0].reshape(L, H, nb, B, D).transpose(0, 2, 1, 3, 4)
     k_arena = k_arena.at[:, block_ids].set(kb.astype(k_arena.dtype))
@@ -240,7 +296,8 @@ def _insert_blocks(k_arena, v_arena, k_new, v_new, block_ids):
 
 
 def _decode_step_paged(model, params, token, pos, tables, k_arena,
-                       v_arena, *, attn_impl: str = "gather"):
+                       v_arena, k_scale=None, v_scale=None, *,
+                       attn_impl: str = "gather"):
     """One cached decode step over S slots against PAGED caches: same
     contract as :func:`_decode_step_slots`, but each slot's KV lives in
     pool blocks named by its row of ``tables`` (S, M) int32 — a
@@ -253,10 +310,19 @@ def _decode_step_paged(model, params, token, pos, tables, k_arena,
     Pallas block-table kernel (``attn_impl="paged_kernel"``,
     ``ops.paged_attention`` — same f32 softmax formulation, so streams
     stay token-exact across the two).  Arenas (L, N, H, B, D) are
-    donated by the serving engine."""
+    donated by the serving engine.
+
+    ``k_scale``/``v_scale`` (L, N, H, B) f32 mark int8 arenas
+    (``BlockPool(kv_quant="int8")``): the new k/v row is quantized per
+    (slot, head) on write and the gather dequantizes in-flight.  The
+    Pallas paged kernel reads raw blocks, so quantized pools require
+    the gather path."""
     if attn_impl not in ("gather", "paged_kernel"):
         raise ValueError(f"attn_impl must be 'gather' or 'paged_kernel', "
                          f"got {attn_impl!r}")
+    if k_scale is not None and attn_impl == "paged_kernel":
+        raise ValueError("kv_quant='int8' requires decode_attn='gather' "
+                         "(the Pallas paged kernel reads raw blocks)")
     mha = model._mha
     s, m = tables.shape
     B = k_arena.shape[3]
@@ -272,13 +338,26 @@ def _decode_step_paged(model, params, token, pos, tables, k_arena,
     blk = tables[jnp.arange(s), pos // B]
     off = pos % B
 
+    quantized = k_scale is not None
+
     def body(carry, layer):
         h = carry
-        bp, kc, vc = layer          # kc/vc: (N, H, B, D) one layer
+        if quantized:
+            bp, kc, vc, ks, vs = layer
+        else:
+            bp, kc, vc = layer      # kc/vc: (N, H, B, D) one layer
         q, k, v = _block_qkv(model, bp, h)  # (S, H, 1, D)
         q, k = model._rope(q, k, positions)
-        kc = kc.at[blk, :, off, :].set(k[:, :, 0, :].astype(kc.dtype))
-        vc = vc.at[blk, :, off, :].set(v[:, :, 0, :].astype(vc.dtype))
+        if quantized:
+            kq, ksr = _kv_quantize_rows(k[:, :, 0, :])   # (S, H, D/-)
+            vq, vsr = _kv_quantize_rows(v[:, :, 0, :])
+            kc = kc.at[blk, :, off, :].set(kq)
+            vc = vc.at[blk, :, off, :].set(vq)
+            ks = ks.at[blk, :, off].set(ksr)
+            vs = vs.at[blk, :, off].set(vsr)
+        else:
+            kc = kc.at[blk, :, off, :].set(k[:, :, 0, :].astype(kc.dtype))
+            vc = vc.at[blk, :, off, :].set(v[:, :, 0, :].astype(vc.dtype))
         if attn_impl == "paged_kernel":
             # in-place block reads via the table (no kc[tables] dense
             # materialization); numerics identical to the gather below
@@ -288,9 +367,13 @@ def _decode_step_paged(model, params, token, pos, tables, k_arena,
             # gather-by-table: (S, M, H, B, D) -> (S, H, M*B, D);
             # position p maps to (p // B, p % B), so the gathered axis
             # IS the position
-            kg = kc[tables].transpose(0, 2, 1, 3, 4).reshape(
+            kg, vg = kc[tables], vc[tables]       # (S, M, H, B, D)
+            if quantized:           # dequant inside the gather
+                kg = kg.astype(jnp.float32) * ks[tables][..., None]
+                vg = vg.astype(jnp.float32) * vs[tables][..., None]
+            kg = kg.transpose(0, 2, 1, 3, 4).reshape(
                 s, mha.n_head, ctx, mha.head_dim)
-            vg = vc[tables].transpose(0, 2, 1, 3, 4).reshape(
+            vg = vg.transpose(0, 2, 1, 3, 4).reshape(
                 s, mha.n_head, ctx, mha.head_dim)
             scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                                 kg.astype(jnp.float32))
@@ -299,19 +382,24 @@ def _decode_step_paged(model, params, token, pos, tables, k_arena,
             w = jax.nn.softmax(scores, axis=-1)
             o = jnp.einsum("bhqk,bhkd->bhqd", w, vg.astype(jnp.float32))
         h = _finish_block(model, bp, h, o.astype(h.dtype))
-        return h, (kc, vc)
+        return h, ((kc, vc, ks, vs) if quantized else (kc, vc))
 
-    h, (k_arena, v_arena) = lax.scan(
-        body, h, (params["blocks"], k_arena, v_arena))
+    if quantized:
+        h, (k_arena, v_arena, k_scale, v_scale) = lax.scan(
+            body, h, (params["blocks"], k_arena, v_arena, k_scale, v_scale))
+    else:
+        h, (k_arena, v_arena) = lax.scan(
+            body, h, (params["blocks"], k_arena, v_arena))
     h = model._layer_norm(params["ln_f"], h)
-    head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
-            else params["head"].astype(h.dtype))
-    logits = (h @ head)[:, 0]
-    return logits.astype(jnp.float32), k_arena, v_arena
+    logits = _head_logits(model, params, h)[:, 0]
+    logits = logits.astype(jnp.float32)
+    if quantized:
+        return logits, k_arena, v_arena, k_scale, v_scale
+    return logits, k_arena, v_arena
 
 
 def _verify_step_paged(model, params, tokens, pos, n_cand, tables,
-                       k_arena, v_arena):
+                       k_arena, v_arena, k_scale=None, v_scale=None):
     """Speculative VERIFY over paged caches: score all W = k+1 candidate
     rows per slot in one fixed-shape step.  ``tokens`` (S, W) int32
     0-based — row layout ``[last_emitted, draft_1 .. draft_k]`` — and
@@ -359,20 +447,37 @@ def _verify_step_paged(model, params, tokens, pos, n_cand, tables,
                     tables[rowsel, blkcol], 0)       # (S, W)
     off = abspos % B
 
+    quantized = k_scale is not None
+
     def body(carry, layer):
         h = carry
-        bp, kc, vc = layer          # kc/vc: (N, H, B, D) one layer
+        if quantized:
+            bp, kc, vc, ks, vs = layer
+        else:
+            bp, kc, vc = layer      # kc/vc: (N, H, B, D) one layer
         q, k, v = _block_qkv(model, bp, h)  # (S, H, W, D)
         q, k = model._rope(q, k, positions)
         # advanced-index write: (S, W) block/offset pairs each take an
         # (H, D) row — update shaped (S, W, H, D)
-        kc = kc.at[blk, :, off, :].set(
-            k.transpose(0, 2, 1, 3).astype(kc.dtype))
-        vc = vc.at[blk, :, off, :].set(
-            v.transpose(0, 2, 1, 3).astype(vc.dtype))
-        kg = kc[tables].transpose(0, 2, 1, 3, 4).reshape(
+        if quantized:
+            kq, ksr = _kv_quantize_rows(k.transpose(0, 2, 1, 3))
+            vq, vsr = _kv_quantize_rows(v.transpose(0, 2, 1, 3))
+            kc = kc.at[blk, :, off, :].set(kq)
+            vc = vc.at[blk, :, off, :].set(vq)
+            ks = ks.at[blk, :, off].set(ksr)
+            vs = vs.at[blk, :, off].set(vsr)
+        else:
+            kc = kc.at[blk, :, off, :].set(
+                k.transpose(0, 2, 1, 3).astype(kc.dtype))
+            vc = vc.at[blk, :, off, :].set(
+                v.transpose(0, 2, 1, 3).astype(vc.dtype))
+        kg, vg = kc[tables], vc[tables]           # (S, M, H, B, D)
+        if quantized:               # dequant inside the gather
+            kg = kg.astype(jnp.float32) * ks[tables][..., None]
+            vg = vg.astype(jnp.float32) * vs[tables][..., None]
+        kg = kg.transpose(0, 2, 1, 3, 4).reshape(
             s, mha.n_head, ctx, mha.head_dim)
-        vg = vc[tables].transpose(0, 2, 1, 3, 4).reshape(
+        vg = vg.transpose(0, 2, 1, 3, 4).reshape(
             s, mha.n_head, ctx, mha.head_dim)
         scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                             kg.astype(jnp.float32))
@@ -381,15 +486,20 @@ def _verify_step_paged(model, params, tokens, pos, n_cand, tables,
         wts = jax.nn.softmax(scores, axis=-1)
         o = jnp.einsum("bhqk,bhkd->bhqd", wts, vg.astype(jnp.float32))
         h = _finish_block(model, bp, h, o.astype(h.dtype))
-        return h, (kc, vc)
+        return h, ((kc, vc, ks, vs) if quantized else (kc, vc))
 
-    h, (k_arena, v_arena) = lax.scan(
-        body, h, (params["blocks"], k_arena, v_arena))
+    if quantized:
+        h, (k_arena, v_arena, k_scale, v_scale) = lax.scan(
+            body, h, (params["blocks"], k_arena, v_arena, k_scale, v_scale))
+    else:
+        h, (k_arena, v_arena) = lax.scan(
+            body, h, (params["blocks"], k_arena, v_arena))
     h = model._layer_norm(params["ln_f"], h)
-    head = (params["embed"].T.astype(h.dtype) if model.tie_embeddings
-            else params["head"].astype(h.dtype))
-    logits = h @ head                                # (S, W, V)
-    return logits.astype(jnp.float32), k_arena, v_arena
+    logits = _head_logits(model, params, h)      # (S, W, V)
+    logits = logits.astype(jnp.float32)
+    if quantized:
+        return logits, k_arena, v_arena, k_scale, v_scale
+    return logits, k_arena, v_arena
 
 
 def _decode_step(model, params, token, pos, k_cache, v_cache):

@@ -48,10 +48,11 @@ def match_compute_dtype(x, w):
     low precision (1-based LookupTable/embedding ids riding float32) never
     reach this helper: id-consuming layers convert to int before any
     weight touches the value."""
-    if (jnp.issubdtype(x.dtype, jnp.floating)
-            and jnp.issubdtype(w.dtype, jnp.floating)
-            and x.dtype != w.dtype):
-        return x.astype(w.dtype)
+    wdt = getattr(w, "dtype", None)  # QTensor weights align in-kernel
+    if (wdt is not None and jnp.issubdtype(x.dtype, jnp.floating)
+            and jnp.issubdtype(wdt, jnp.floating)
+            and x.dtype != wdt):
+        return x.astype(wdt)
     return x
 
 

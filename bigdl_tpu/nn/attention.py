@@ -193,19 +193,23 @@ class MultiHeadAttention(Module):
         return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
 
     def project_qkv(self, params, q_in, k_in, v_in):
+        # qmatmul is the QTensor-aware seam: plain arrays fall straight
+        # through to @, int8-compute drafter weights hit the MXU as int8
+        from bigdl_tpu.quant.kernels import qmatmul
         q_in = match_compute_dtype(jnp.asarray(q_in), params["wq"])
         k_in = match_compute_dtype(jnp.asarray(k_in), params["wk"])
         v_in = match_compute_dtype(jnp.asarray(v_in), params["wv"])
-        q = q_in @ params["wq"]
-        k = k_in @ params["wk"]
-        v = v_in @ params["wv"]
+        q = qmatmul(q_in, params["wq"])
+        k = qmatmul(k_in, params["wk"])
+        v = qmatmul(v_in, params["wv"])
         if self.with_bias:
             q, k, v = q + params["bq"], k + params["bk"], v + params["bv"]
         return (self._split_heads(q), self._split_heads(k),
                 self._split_heads(v))
 
     def project_out(self, params, o):
-        y = self._merge_heads(o) @ params["wo"]
+        from bigdl_tpu.quant.kernels import qmatmul
+        y = qmatmul(self._merge_heads(o), params["wo"])
         if self.with_bias:
             y = y + params["bo"]
         return y

@@ -414,28 +414,37 @@ class Module:
         self._built()
         return ServingEngine(self, **kwargs)
 
-    def quantize(self, dtype: str = "int8", *, policy=None) -> "Module":
+    def quantize(self, dtype: str = "int8", *, policy=None,
+                 compute: Optional[str] = None) -> "Module":
         """Weight-only quantized EVAL-MODE clone of this built module
         (``self`` keeps its f32 params untouched — both replicas can be
         served side by side, the compile cache keys them apart).
 
         ``dtype="int8"``: eligible weights become
         :class:`~bigdl_tpu.quant.QTensor` (int8 + per-channel f32
-        scales); Linear/Conv dequantize on the fly inside their MXU
-        kernel (bf16 operands, f32 accumulation), everything else
-        expands at the jit entry.  ``dtype="bf16"``: a plain storage
-        cast.  The include/exclude ``policy`` defaults skip norms,
-        biases and embedding tables (see quant.QuantPolicy).
+        scales).  ``compute`` picks the kernel regime: the default
+        ``"dequant"`` dequantizes on the fly inside the MXU kernel
+        (bf16 operands, f32 accumulation); ``"int8"`` quantizes
+        activations per token and feeds BOTH int8 operands to the MXU
+        with exact int32 accumulation and one f32 rescale; ``"auto"``
+        follows the measured int8-vs-dequant duel in ops/autotune.py;
+        ``"fp8"`` gates on capable device kinds.  ``dtype="bf16"``: a
+        plain storage cast.  The include/exclude ``policy`` defaults
+        skip norms, biases and embedding tables (see quant.QuantPolicy);
+        an explicit ``policy`` wins over ``compute``.
 
         The clone is inference-only: its int8 leaves are not
         differentiable, so train on the f32 original and re-quantize.
-        Byte savings and per-layer max abs dequant error are published
+        Byte savings, per-layer max abs dequant error and (for int8
+        compute) the int32-accumulator overflow-risk gauge are published
         as ``quant/*`` gauges on the obs registry and kept on
         ``clone.quant_report``.
         """
         from bigdl_tpu.obs import get_registry
-        from bigdl_tpu.quant import quantize_params
+        from bigdl_tpu.quant import QuantPolicy, quantize_params
         self._built()
+        if policy is None and compute is not None:
+            policy = QuantPolicy(dtype, compute=compute)
         report: dict = {}
         new = self.clone_module()
         new.params = quantize_params(self.params, dtype, policy=policy,
@@ -449,6 +458,10 @@ class Module:
             report["max_abs_dequant_error"])
         for path, err in report["per_layer_max_abs_err"].items():
             reg.gauge(f"quant/max_abs_dequant_error/{path}").set(err)
+        if report.get("per_layer_overflow_risk"):
+            reg.gauge("quant/overflow_risk").set(report["overflow_risk"])
+            for path, risk in report["per_layer_overflow_risk"].items():
+                reg.gauge(f"quant/overflow_risk/{path}").set(risk)
         return new.evaluate()
 
     def __repr__(self) -> str:

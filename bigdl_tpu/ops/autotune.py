@@ -15,6 +15,12 @@ cache instead of a hard-coded block size.  Two families are tuned:
 * **paged decode** — times ``ops.paged_attention`` against the dense
   ``kc[tables]`` gather per ``(head_dim, block_len, dtype)`` so
   ``LMServingEngine``'s "auto" decode dispatch is measurement-backed.
+* **qcompute duel** — times the true int8xint8 MXU matmul
+  (``quant.kernels.qmatmul_i8``: per-token activation quant, int32
+  accumulation, one f32 rescale) against the dequant-bf16 baseline per
+  ``(m, k, n)`` activation/weight shape, so ``QuantPolicy
+  (compute="auto")`` resolves to int8 only where it measured faster —
+  the same never-lose-to-the-baseline contract as the other families.
 
 The cache is a resumable measurement artifact like every other tool in
 this repo (TUNE_ATTN.json, committed): a row is flushed after every
@@ -84,6 +90,10 @@ def attention_key(seq_len: int, head_dim: int, dtype, causal: bool) -> str:
 def paged_key(head_dim: int, block_len: int, dtype) -> str:
     return "paged_d%d_b%d_%s" % (int(head_dim), int(block_len),
                                  _dtype_name(dtype))
+
+
+def qcompute_key(m: int, k: int, n: int) -> str:
+    return "qcompute_m%d_k%d_n%d" % (int(m), int(k), int(n))
 
 
 def parse_grid(spec: str) -> Tuple[Tuple[int, int], ...]:
@@ -169,10 +179,41 @@ def lookup_paged(head_dim: int, block_len: int, dtype,
                             w.get("kernel_step_s"), w.get("gather_step_s"))
 
 
+def lookup_qcompute(m: int, k: int, n: int,
+                    *, path: Optional[str] = None) -> Optional[str]:
+    """Measured winner of the int8-compute-vs-dequant duel for an
+    ``(m, k, n)`` matmul on THE ATTACHED device kind: "int8", "dequant",
+    or None when there is no verdict (``compute="auto"`` treats None as
+    dequant, so auto can never lose to the baseline).  An exact (m, k,
+    n) entry wins; otherwise the verdict of the largest-m entry with the
+    same (k, n) applies — m is the token batch, which varies run to run,
+    while (k, n) is the layer geometry the duel was tuned for."""
+    doc = load_cache(path)
+    if not isinstance(doc, dict) or doc.get("device_kind") != _device_kind():
+        return None
+    winners = doc.get("winners") or {}
+    w = winners.get(qcompute_key(m, k, n))
+    if isinstance(w, dict) and w.get("use_int8") is not None:
+        return "int8" if w["use_int8"] else "dequant"
+    best = None
+    for entry in winners.values():
+        if (isinstance(entry, dict) and entry.get("qcompute")
+                and entry.get("k") == int(k) and entry.get("n") == int(n)
+                and entry.get("use_int8") is not None):
+            if best is None or entry.get("m", 0) > best.get("m", 0):
+                best = entry
+    if best is None:
+        return None
+    return "int8" if best["use_int8"] else "dequant"
+
+
 # ---------------------------------------------------------------------------
 # winner recomputation (from ALL rows, every flush)
 
 def _row_key(r) -> tuple:
+    if r.get("kind") == "qcompute":
+        return ("qcompute", r.get("impl"), r.get("m"), r.get("k"),
+                r.get("n"))
     if r.get("kind") == "paged_decode":
         return ("paged_decode", r.get("impl"), r.get("slots"),
                 r.get("heads"), r.get("head_dim"), r.get("cache_len"),
@@ -184,11 +225,14 @@ def _row_key(r) -> tuple:
 
 def _recompute_winners(rows) -> dict:
     winners = {}
-    att, paged = {}, {}
+    att, paged, qcomp = {}, {}, {}
     for r in rows:
         if not isinstance(r, dict):
             continue
-        if r.get("kind") == "paged_decode":
+        if r.get("kind") == "qcompute":
+            cfg = (r.get("m"), r.get("k"), r.get("n"))
+            qcomp.setdefault(cfg, []).append(r)
+        elif r.get("kind") == "paged_decode":
             cfg = (r.get("head_dim"), r.get("block_len"), r.get("dtype"))
             paged.setdefault(cfg, []).append(r)
         elif r.get("kind") == "train_step":
@@ -237,6 +281,26 @@ def _recompute_winners(rows) -> dict:
         else:
             entry["use_kernel"] = None
         winners[paged_key(d, bl, dt)] = entry
+    for (m, k, n), rs in sorted(qcomp.items(), key=str):
+        by = {}
+        for r in rs:
+            if "step_s" in r:
+                prev = by.get(r.get("impl"))
+                if prev is None or r["step_s"] < prev:
+                    by[r.get("impl")] = r["step_s"]
+        entry = {"qcompute": True, "m": m, "k": k, "n": n}
+        i8, dq = by.get("int8_compute"), by.get("dequant_bf16")
+        if i8 is not None:
+            entry["int8_step_s"] = i8
+        if dq is not None:
+            entry["dequant_step_s"] = dq
+        if i8 is not None and dq is not None:
+            # strict <: a tie keeps the baseline (auto never loses)
+            entry["use_int8"] = i8 < dq
+            entry["int8_speedup_vs_dequant"] = round(dq / i8, 4)
+        else:
+            entry["use_int8"] = None
+        winners[qcompute_key(m, k, n)] = entry
     return winners
 
 
@@ -435,6 +499,61 @@ def autotune_paged_decode(*, slots: int = 8, heads: int = 8,
             step = _op_step_time(fns[cand["impl"]],
                                  (q, ka, va, tables, pos), iters)
             row["step_s"] = round(step, 6)
+        except Exception as e:  # noqa: BLE001
+            row["error"] = ("%s: %s" % (type(e).__name__, e))[:500]
+        return row
+
+    return _run_sweep(cands, measure, run_match,
+                      path=path, finalize=finalize, log=log)
+
+
+#: default (m, k, n) duel shapes: decode-row (m=slots) and prefill-tile
+#: (m=tokens) activations against serving-scale layer geometries
+DEFAULT_QCOMPUTE_SHAPES: Tuple[Tuple[int, int, int], ...] = (
+    (8, 1024, 1024), (8, 1024, 4096),
+    (256, 1024, 1024), (256, 1024, 4096),
+)
+
+
+def autotune_qcompute(shapes: Sequence[Tuple[int, int, int]]
+                      = DEFAULT_QCOMPUTE_SHAPES, *, iters: int = 20,
+                      path: Optional[str] = None, finalize: bool = True,
+                      log=print) -> dict:
+    """The int8-compute-vs-dequant duel: per (m, k, n), time the true
+    int8xint8 MXU matmul (``qmatmul_i8``: per-token activation quant +
+    int32 accumulation + f32 rescale, all inside the jit) against the
+    dequant-bf16 baseline (``qmatmul`` on a dequant-mode QTensor — the
+    storage-only recipe).  Winners persist per device_kind in the shared
+    tuning cache; ``QuantPolicy(compute="auto")`` resolves through
+    :func:`lookup_qcompute`, so auto can never lose to dequant."""
+    from bigdl_tpu.quant.kernels import qmatmul, qmatmul_i8
+    from bigdl_tpu.quant.qtensor import quantize_array
+    path = path or cache_path()
+    cands = []
+    for m, k, n in shapes:
+        ident = {"m": int(m), "k": int(k), "n": int(n), "iters": int(iters)}
+        cands.append(dict(kind="qcompute", impl="int8_compute", **ident))
+        cands.append(dict(kind="qcompute", impl="dequant_bf16", **ident))
+
+    def run_match(r):
+        return r.get("iters") == iters
+
+    fns = {"int8_compute": jax.jit(qmatmul_i8),
+           "dequant_bf16": jax.jit(qmatmul)}
+
+    def measure(cand):
+        row = dict(cand)
+        m, k, n = cand["m"], cand["k"], cand["n"]
+        ks = jax.random.split(jax.random.PRNGKey(0), 2)
+        x = jax.random.normal(ks[0], (m, k), jnp.float32)
+        w = jax.random.normal(ks[1], (k, n), jnp.float32)
+        qw = quantize_array(w, (0,),
+                            compute="int8" if cand["impl"] == "int8_compute"
+                            else "dequant")
+        try:
+            step = _op_step_time(fns[cand["impl"]], (x, qw), iters)
+            row["step_s"] = round(step, 6)
+            row["tokens_per_s"] = round(m / step, 1)
         except Exception as e:  # noqa: BLE001
             row["error"] = ("%s: %s" % (type(e).__name__, e))[:500]
         return row

@@ -1,0 +1,139 @@
+"""Activation quantization for true low-precision MXU compute.
+
+Weight-only quantization (qtensor.py) halves nothing on the compute
+side: the MXU still sees bf16 operands.  int8×int8 compute needs the
+*activation* operand quantized too, and activations — unlike weights —
+change every step, so there are two regimes:
+
+- **dynamic per-token** (the default): each token row takes its own
+  symmetric scale ``amax/127`` over the contraction axis, computed
+  inside the traced kernel.  No calibration, tracks outliers exactly,
+  costs one extra reduction per matmul.
+- **static calibrated**: an :class:`ActCalibrator` records running
+  absmax over sample batches; the frozen per-site scalar scale rides
+  the weight's ``QTensor.act_scale`` aux (attach_act_scales), removing
+  the runtime reduction at the price of clipping anything beyond the
+  calibration range.
+
+fp8 variants exist behind :func:`fp8_supported` — a *device-kind* gate,
+not a dtype-availability one: jnp carries float8 types everywhere, but
+only recent accelerator generations (and no CPU) run fp8 matmuls on the
+matrix unit, so policy-level fp8 requests refuse loudly elsewhere
+instead of silently emulating.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu.quant.qtensor import QMAX, _EPS, is_qtensor
+
+#: device kinds whose MXU generation natively computes fp8 matmuls
+_FP8_KIND_RE = re.compile(r"(v5|v6|v7|trillium|ironwood|h100|h200|b200)",
+                          re.IGNORECASE)
+
+FP8_DTYPE = getattr(jnp, "float8_e4m3fn", None)
+
+
+def fp8_supported(device=None) -> bool:
+    """True when the runtime dtype exists AND the first device's kind is
+    an fp8-capable accelerator generation."""
+    if FP8_DTYPE is None:
+        return False
+    try:
+        dev = device or jax.devices()[0]
+    except Exception:  # noqa: BLE001 — backend down: not capable
+        return False
+    return bool(_FP8_KIND_RE.search(getattr(dev, "device_kind", "") or ""))
+
+
+def quantize_per_token(x, *, scale: Optional[float] = None):
+    """Symmetric int8 per-token activation quantization.
+
+    ``x`` (..., K) float; the scale reduces over the LAST axis (the
+    contraction axis of every ``x @ w`` / ``x @ w.T`` consumer), one
+    scale per leading-row "token".  A calibrated static ``scale``
+    (scalar, from :class:`ActCalibrator`) skips the dynamic reduction.
+    Returns ``(q int8 (..., K), scale f32 (..., 1))``.
+    """
+    xf = x.astype(jnp.float32)
+    if scale is not None:
+        s = jnp.full(xf.shape[:-1] + (1,), jnp.float32(scale))
+    else:
+        amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+        s = jnp.maximum(amax, _EPS) / QMAX
+    q = jnp.clip(jnp.round(xf / s), -QMAX, QMAX).astype(jnp.int8)
+    return q, s
+
+
+def quantize_per_token_fp8(x, *, force: bool = False):
+    """fp8(e4m3) per-token activation cast with the same scale layout as
+    :func:`quantize_per_token` (scaled so the row amax lands near the
+    format's top, then cast).  Gated on :func:`fp8_supported` unless
+    ``force`` (tests exercise the numerics on any backend that carries
+    the dtype)."""
+    if FP8_DTYPE is None:
+        raise NotImplementedError("this jax build has no float8_e4m3fn")
+    if not force and not fp8_supported():
+        raise NotImplementedError(
+            "fp8 compute is gated on capable device kinds "
+            f"({_FP8_KIND_RE.pattern}); this backend is not one")
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    s = jnp.maximum(amax, _EPS) / 448.0  # e4m3 max normal
+    return (xf / s).astype(FP8_DTYPE), s
+
+
+class ActCalibrator:
+    """Record running absmax per call site over sample batches, then
+    freeze static activation scales.
+
+        cal = ActCalibrator()
+        for batch in sample_batches:
+            cal.observe("blocks/attn/wq", batch_activation)
+        scales = cal.scales()                      # site -> float
+        qparams = attach_act_scales(qparams, scales)
+
+    Observation is host-side (one ``jnp.max`` sync per call) — this is
+    an offline pass over a handful of batches, not a serving-path op.
+    """
+
+    def __init__(self):
+        self._amax: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+
+    def observe(self, site: str, x) -> None:
+        amax = float(jnp.max(jnp.abs(jnp.asarray(x).astype(jnp.float32))))
+        self._amax[site] = max(self._amax.get(site, 0.0), amax)
+        self._counts[site] = self._counts.get(site, 0) + 1
+
+    def scales(self) -> Dict[str, float]:
+        """site -> frozen static scale (absmax/127, floored at _EPS)."""
+        return {site: max(amax, _EPS) / QMAX
+                for site, amax in self._amax.items()}
+
+    def describe(self) -> Dict[str, dict]:
+        return {site: {"amax": self._amax[site],
+                       "batches": self._counts[site]}
+                for site in self._amax}
+
+
+def attach_act_scales(params, scales: Dict[str, float]):
+    """Pin calibrated static activation scales onto QTensor leaves by
+    tree path (``/``-joined, the quant_report key layout).  Unmatched
+    paths are ignored; unmatched scales are a silent no-op by design —
+    calibration sets may be broader than one submodel."""
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            return {k: walk(v, path + (str(k),)) for k, v in node.items()}
+        if is_qtensor(node):
+            s = scales.get("/".join(path))
+            if s is not None:
+                return node.with_compute(node.compute, act_scale=float(s))
+        return node
+
+    return walk(params, ())

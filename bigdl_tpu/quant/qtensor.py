@@ -41,16 +41,34 @@ QMAX = 127
 
 
 class QTensor:
-    """int8 values + broadcast-shaped f32 scales (symmetric)."""
+    """int8 values + broadcast-shaped f32 scales (symmetric).
 
-    __slots__ = ("q", "scale", "orig_dtype", "native")
+    ``compute`` selects what the consuming kernel does with the leaf:
+    ``"dequant"`` (the storage-only default: expand to bf16/f32 before
+    the MXU), ``"int8"`` (feed the int8 values straight to the MXU with
+    int32 accumulation — quant/kernels.py ``*_i8`` paths), or ``"auto"``
+    (per-shape winner of the measured int8-vs-dequant duel in
+    ops/autotune.py).  ``act_scale`` optionally pins a calibrated static
+    per-tensor activation scale (quant/activations.py) — ``None`` means
+    dynamic per-token quantization at trace time.  Both ride the pytree
+    aux data, so tree_map/jit/AOT treat differently-configured leaves as
+    distinct structures (separate compile-cache entries)."""
+
+    __slots__ = ("q", "scale", "orig_dtype", "native", "compute",
+                 "act_scale")
 
     def __init__(self, q, scale, orig_dtype: str = "float32",
-                 native: bool = False):
+                 native: bool = False, compute: str = "dequant",
+                 act_scale: Optional[float] = None):
+        if compute not in ("dequant", "int8", "auto", "fp8"):
+            raise ValueError(f"compute must be 'dequant', 'int8', "
+                             f"'auto' or 'fp8', got {compute!r}")
         self.q = q
         self.scale = scale
         self.orig_dtype = str(orig_dtype)
         self.native = bool(native)
+        self.compute = compute
+        self.act_scale = None if act_scale is None else float(act_scale)
 
     # -- array-ish surface --------------------------------------------- #
     @property
@@ -79,19 +97,27 @@ class QTensor:
         w = self.q.astype(jnp.float32) * self.scale
         return w.astype(target)
 
+    def with_compute(self, compute: str,
+                     act_scale: Optional[float] = None) -> "QTensor":
+        """Same payload, different compute mode (buffers are shared)."""
+        return QTensor(self.q, self.scale, self.orig_dtype, self.native,
+                       compute,
+                       self.act_scale if act_scale is None else act_scale)
+
     def __repr__(self) -> str:
         return (f"QTensor(shape={self.shape}, scale={tuple(self.scale.shape)}, "
-                f"orig={self.orig_dtype}, native={self.native})")
+                f"orig={self.orig_dtype}, native={self.native}, "
+                f"compute={self.compute})")
 
 
 def _flatten(t: QTensor):
-    return (t.q, t.scale), (t.orig_dtype, t.native)
+    return (t.q, t.scale), (t.orig_dtype, t.native, t.compute, t.act_scale)
 
 
 def _unflatten(aux, children) -> QTensor:
     q, scale = children
-    orig_dtype, native = aux
-    return QTensor(q, scale, orig_dtype, native)
+    orig_dtype, native, compute, act_scale = aux
+    return QTensor(q, scale, orig_dtype, native, compute, act_scale)
 
 
 jax.tree_util.register_pytree_node(QTensor, _flatten, _unflatten)
@@ -102,7 +128,8 @@ def is_qtensor(x) -> bool:
 
 
 def quantize_array(w, reduce_axes: Optional[Tuple[int, ...]] = None,
-                   *, native: bool = False) -> QTensor:
+                   *, native: bool = False,
+                   compute: str = "dequant") -> QTensor:
     """Quantize ``w`` symmetrically to int8.
 
     ``reduce_axes`` are the axes the scale statistics reduce over — the
@@ -119,7 +146,7 @@ def quantize_array(w, reduce_axes: Optional[Tuple[int, ...]] = None,
     amax = jnp.max(jnp.abs(wf), axis=axes, keepdims=True)
     scale = jnp.maximum(amax, _EPS) / QMAX
     q = jnp.clip(jnp.round(wf / scale), -QMAX, QMAX).astype(jnp.int8)
-    return QTensor(q, scale, orig_dtype, native)
+    return QTensor(q, scale, orig_dtype, native, compute)
 
 
 def dequantize_array(t, dtype=None):

@@ -42,6 +42,13 @@ from bigdl_tpu.utils.transfer import DEFAULT_CHUNK_BYTES, chunked_device_put
 _SKIP_NAME_RE = re.compile(r"^(bias|b\d*|b[qkvo]|beta|gamma|embed(ding)?"
                            r"|pos(_emb)?|wte|wpe)$")
 
+#: non-native leaf names whose consuming matmul site is QTensor-aware
+#: (quant/kernels.qmatmul: transformer attention projections, MLP
+#: halves, the untied head).  Only these may carry a compute mode past
+#: the jit-entry dequant seam — any other generic leaf is consumed by
+#: code that reads params directly and must keep expanding there.
+_COMPUTE_NAME_RE = re.compile(r"^(wq|wk|wv|wo|w1|w2|head)$")
+
 
 class QuantPolicy:
     """Include/exclude policy for :func:`quantize_params`.
@@ -54,22 +61,53 @@ class QuantPolicy:
             overhead and accuracy risk buy back almost no bytes).
         skip_name_re: regex on the leaf's own key name.
         skip_path_re: optional regex on the full ``/``-joined tree path.
+        compute: what the consuming kernel does with int8 leaves —
+            ``"dequant"`` (storage-only, the default), ``"int8"`` (true
+            int8×int8 MXU compute with per-token activation
+            quantization), ``"auto"`` (the measured int8-vs-dequant
+            duel per shape/device_kind), or ``"fp8"`` (gated on capable
+            device kinds via activations.fp8_supported()).
+        compute_name_re: which NON-native leaf names are allowed to
+            carry a non-dequant compute mode (defaults to the
+            transformer matmul sites kernels.qmatmul serves); native
+            Linear/Conv weights always qualify — their own layer
+            kernels dispatch.
     """
 
     def __init__(self, dtype: str = "int8", *, min_ndim: int = 2,
                  min_size: int = 128,
                  skip_name_re=_SKIP_NAME_RE,
-                 skip_path_re=None):
+                 skip_path_re=None,
+                 compute: str = "dequant",
+                 compute_name_re=_COMPUTE_NAME_RE):
         if dtype not in ("int8", "bf16"):
             raise ValueError(f"unsupported quant dtype {dtype!r} "
                              "(int8 or bf16)")
+        if compute not in ("dequant", "int8", "auto", "fp8"):
+            raise ValueError(f"unsupported compute mode {compute!r} "
+                             "(dequant, int8, auto or fp8)")
+        if compute != "dequant" and dtype != "int8":
+            raise ValueError(f"compute={compute!r} needs dtype='int8' "
+                             f"(got {dtype!r}): only int8 storage feeds "
+                             "the low-precision matmul paths")
+        if compute == "fp8":
+            from bigdl_tpu.quant.activations import fp8_supported
+            if not fp8_supported():
+                raise NotImplementedError(
+                    "compute='fp8' is gated on fp8-capable device "
+                    "kinds; this backend is not one (int8 and dequant "
+                    "work everywhere)")
         self.dtype = dtype
+        self.compute = compute
         self.min_ndim = int(min_ndim)
         self.min_size = int(min_size)
         self.skip_name_re = (re.compile(skip_name_re)
                              if isinstance(skip_name_re, str) else skip_name_re)
         self.skip_path_re = (re.compile(skip_path_re)
                              if isinstance(skip_path_re, str) else skip_path_re)
+        self.compute_name_re = (re.compile(compute_name_re)
+                                if isinstance(compute_name_re, str)
+                                else compute_name_re)
 
     def wants(self, path: Tuple[str, ...], leaf) -> bool:
         """Should this leaf be quantized?  Only float leaves qualify —
@@ -161,9 +199,12 @@ def quantize_params(params, dtype: str = "int8", *,
         policy = QuantPolicy(dtype, min_ndim=policy.min_ndim,
                              min_size=policy.min_size,
                              skip_name_re=policy.skip_name_re,
-                             skip_path_re=policy.skip_path_re)
+                             skip_path_re=policy.skip_path_re,
+                             compute=policy.compute,
+                             compute_name_re=policy.compute_name_re)
     index = _module_index(module) if module is not None else {}
     per_layer_err: Dict[str, float] = {}
+    per_layer_risk: Dict[str, float] = {}
     stats = {"bytes_orig": 0, "bytes_quant": 0,
              "quantized_leaves": 0, "skipped_leaves": 0}
 
@@ -204,24 +245,56 @@ def quantize_params(params, dtype: str = "int8", *,
             # projections, vmap-stacked weights): contraction is the
             # second-to-last axis; every other axis keeps its own scale
             reduce_axes, native = (-2,), False
-        qt = quantize_array(node, reduce_axes, native=native)
+        name = path[-1] if path else ""
+        compute = policy.compute
+        if compute != "dequant" and not native \
+                and not (policy.compute_name_re is not None
+                         and policy.compute_name_re.match(name)):
+            # generic leaf with no QTensor-aware consumer: storage-only
+            compute = "dequant"
+        qt = quantize_array(node, reduce_axes, native=native,
+                            compute=compute)
         stats["bytes_quant"] += qt.nbytes
         if report is not None:
             err = float(jnp.max(jnp.abs(node - qt.dequantize(node.dtype))))
             per_layer_err["/".join(path)] = err
+            if compute in ("int8", "auto"):
+                per_layer_risk["/".join(path)] = _overflow_risk(
+                    qt, reduce_axes)
         return qt
 
     out = transform(params, ())
     if report is not None:
         report.update(stats)
         report["dtype"] = dtype
+        report["compute_mode"] = policy.compute
         report["payload_ratio"] = (stats["bytes_quant"]
                                    / max(stats["bytes_orig"], 1))
         report["bytes_saved"] = stats["bytes_orig"] - stats["bytes_quant"]
         report["per_layer_max_abs_err"] = per_layer_err
         report["max_abs_dequant_error"] = (max(per_layer_err.values())
                                            if per_layer_err else 0.0)
+        report["per_layer_overflow_risk"] = per_layer_risk
+        report["overflow_risk"] = (max(per_layer_risk.values())
+                                   if per_layer_risk else 0.0)
     return out
+
+
+def _overflow_risk(qt: QTensor, reduce_axes) -> float:
+    """Worst-case int32-accumulator fill for an int8-compute matmul:
+    ``max|q_w| * 127 * K / 2^31`` with K the contraction length — 127 is
+    the activation bound by construction (per-token symmetric quant).
+    A value near 1.0 means a bad calibration or a pathological weight
+    could wrap the accumulator and silently corrupt acceptance rate;
+    the obs gauge surfaces it before that happens."""
+    shape = qt.q.shape
+    axes = tuple(reduce_axes) if reduce_axes is not None \
+        else tuple(range(len(shape)))
+    k = 1
+    for a in axes:
+        k *= int(shape[a])
+    qmax_w = int(jnp.max(jnp.abs(qt.q.astype(jnp.int32))))
+    return float(qmax_w) * 127.0 * float(k) / float(2 ** 31)
 
 
 def dequantize_params(params, dtype=None):
@@ -234,13 +307,64 @@ def dequantize_params(params, dtype=None):
 
 
 def dequantize_entry(params):
-    """The jit-entry seam: expand non-native QTensors (whose consuming
-    module reads params directly) and pass native ones through to their
-    layer kernels.  Traced inside jit, so the expansion fuses and int8
-    remains the stored/transferred form."""
+    """The jit-entry seam: expand non-native *dequant-mode* QTensors
+    (whose consuming module reads params directly) and pass everything
+    else through — native leaves dequantize (or int8-compute) inside
+    their own layer kernels, and non-dequant compute leaves are
+    consumed by the QTensor-aware matmul sites (kernels.qmatmul), so
+    they must survive the seam as int8.  Traced inside jit, so the
+    expansion fuses and int8 remains the stored/transferred form."""
     return jax.tree_util.tree_map(
-        lambda n: n.dequantize() if is_qtensor(n) and not n.native else n,
+        lambda n: (n.dequantize()
+                   if is_qtensor(n) and not n.native
+                   and n.compute == "dequant" else n),
         params, is_leaf=is_qtensor)
+
+
+def set_compute_mode(params, compute: str, *,
+                     compute_name_re=_COMPUTE_NAME_RE):
+    """Rewrite the compute mode of an already-quantized tree (aux-only:
+    int8 payloads are shared, nothing re-rounds).  The same
+    consumable-name guard as quantize_params applies to non-native
+    leaves — a generic leaf whose consumer reads params directly keeps
+    expanding at the seam regardless of the requested mode.  This is
+    how an int8-storage *target* becomes its own int8-*compute* drafter
+    without a second copy of the weights."""
+    if compute not in ("dequant", "int8", "auto", "fp8"):
+        raise ValueError(f"compute must be 'dequant', 'int8', 'auto' or "
+                         f"'fp8', got {compute!r}")
+    name_re = (re.compile(compute_name_re)
+               if isinstance(compute_name_re, str) else compute_name_re)
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            return {k: walk(v, path + (str(k),)) for k, v in node.items()}
+        if is_qtensor(node):
+            name = path[-1] if path else ""
+            eff = compute
+            if compute != "dequant" and not node.native \
+                    and not (name_re is not None and name_re.match(name)):
+                eff = "dequant"
+            if eff != node.compute:
+                return node.with_compute(eff)
+        return node
+
+    return walk(params, ())
+
+
+def params_compute_tag(params) -> Optional[str]:
+    """The dominant compute mode of a params tree ("int8" > "auto" >
+    "dequant"; None when nothing is quantized) — surfaced by
+    quant_report, DraftModel.describe() and the serving/lm/spec/*
+    gauges so a storage-only drafter is never mistaken for a true
+    int8-compute one."""
+    best = None
+    rank = {"dequant": 0, "auto": 1, "int8": 2, "fp8": 3}
+    for leaf in jax.tree_util.tree_leaves(params, is_leaf=is_qtensor):
+        if is_qtensor(leaf):
+            if best is None or rank[leaf.compute] > rank[best]:
+                best = leaf.compute
+    return best
 
 
 # ---------------------------------------------------------------------- #
@@ -281,7 +405,8 @@ def stage_quantized_params(params, *,
         scale = chunked_device_put(np.asarray(node.scale),
                                    chunk_bytes=chunk_bytes, device=device)
         moved += node.nbytes
-        return QTensor(q, scale, node.orig_dtype, node.native)
+        return QTensor(q, scale, node.orig_dtype, node.native,
+                       node.compute, node.act_scale)
 
     out = jax.tree_util.tree_map(stage, params, is_leaf=is_qtensor)
     return out, moved

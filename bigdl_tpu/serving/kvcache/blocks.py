@@ -78,15 +78,25 @@ class BlockPool:
             usable capacity is ``num_blocks - 1``.
         dtype: cache dtype (defaults to f32; the engine passes the
             params' embed dtype).
+        kv_quant: ``None`` (full-precision arenas) or ``"int8"`` —
+            int8 block arenas plus per-(position, head) f32 scale
+            arenas ``self.ks`` / ``self.vs`` shaped (L, N, H, B).  The
+            paged gather dequantizes in-flight (see
+            ``generate._decode_step_paged``); storage drops ~4x minus
+            the 1/D scale overhead.  Lossy: streams are NOT bit-exact
+            vs a full-precision pool.  Quantized pools do not support
+            chain migration (export/adopt) yet — disaggregated serving
+            keeps full-precision pools.
 
-    The jnp arenas are held as ``self.k`` / ``self.v``; callers that
-    run donated executables over them reassign the attributes with the
-    donated outputs (same contract as the slot engine's resident
-    caches).
+    The jnp arenas are held as ``self.k`` / ``self.v`` (plus
+    ``self.ks`` / ``self.vs`` when quantized); callers that run donated
+    executables over them reassign the attributes with the donated
+    outputs (same contract as the slot engine's resident caches).
     """
 
     def __init__(self, *, n_layers: int, n_heads: int, head_dim: int,
-                 block_len: int, num_blocks: int, dtype=None):
+                 block_len: int, num_blocks: int, dtype=None,
+                 kv_quant: Optional[str] = None):
         import jax.numpy as jnp
 
         if block_len < 1:
@@ -95,13 +105,25 @@ class BlockPool:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is scratch), got "
                 f"{num_blocks}")
+        if kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"kv_quant must be None or 'int8', got {kv_quant!r}")
         self.block_len = int(block_len)
         self.num_blocks = int(num_blocks)
         self.shape = (int(n_layers), self.num_blocks, int(n_heads),
                       self.block_len, int(head_dim))
-        dt = dtype if dtype is not None else jnp.float32
-        self.k = jnp.zeros(self.shape, dt)
-        self.v = jnp.zeros(self.shape, dt)
+        self.kv_quant = kv_quant
+        if kv_quant == "int8":
+            self.k = jnp.zeros(self.shape, jnp.int8)
+            self.v = jnp.zeros(self.shape, jnp.int8)
+            # per-(position, head) scales, block-major like the arenas
+            self.ks = jnp.zeros(self.shape[:4], jnp.float32)
+            self.vs = jnp.zeros(self.shape[:4], jnp.float32)
+        else:
+            dt = dtype if dtype is not None else jnp.float32
+            self.k = jnp.zeros(self.shape, dt)
+            self.v = jnp.zeros(self.shape, dt)
+            self.ks = self.vs = None
         self.dtype = self.k.dtype
         self._lock = threading.Lock()
         # pop() from the tail hands out ascending ids first
@@ -126,8 +148,12 @@ class BlockPool:
 
     @property
     def arena_bytes(self) -> int:
-        """HBM footprint of the k + v arenas."""
-        return 2 * self.k.size * self.k.dtype.itemsize
+        """HBM footprint of the k + v arenas (+ scale arenas when
+        quantized)."""
+        n = 2 * self.k.size * self.k.dtype.itemsize
+        if self.ks is not None:
+            n += 2 * self.ks.size * self.ks.dtype.itemsize
+        return n
 
     def utilization(self) -> float:
         return self.used_count / self.capacity if self.capacity else 0.0
@@ -196,6 +222,12 @@ class BlockPool:
         """
         import jax.numpy as jnp
         import numpy as np
+
+        if self.kv_quant is not None:
+            raise NotImplementedError(
+                "chain migration is not supported for quantized pools "
+                "(kv_quant='int8'); disaggregated serving keeps "
+                "full-precision pools")
 
         from bigdl_tpu.utils.transfer import DEFAULT_CHUNK_BYTES
         cb = int(chunk_bytes) if chunk_bytes else DEFAULT_CHUNK_BYTES
@@ -277,6 +309,12 @@ class BlockPool:
         """
         import numpy as np
 
+        if self.kv_quant is not None:
+            raise NotImplementedError(
+                "chain migration is not supported for quantized pools "
+                "(kv_quant='int8'); disaggregated serving keeps "
+                "full-precision pools")
+
         from bigdl_tpu.utils.transfer import (DEFAULT_CHUNK_BYTES,
                                               chunked_device_put)
         k_wire = np.asarray(k_wire)
@@ -330,4 +368,5 @@ class BlockPool:
             "utilization": ((self.capacity - free) / self.capacity
                             if self.capacity else 0.0),
             "arena_bytes": self.arena_bytes,
+            "kv_quant": self.kv_quant or "none",
         }

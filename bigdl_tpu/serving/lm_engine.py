@@ -445,6 +445,13 @@ class LMServingEngine:
             kernel only when the autotune cache has measured it faster
             than the gather ON THIS device kind, the gather otherwise.
             Both produce token-identical streams.
+        kv_quant: ``None`` (full-precision KV, the default) or
+            ``"int8"``: the block pool stores int8 KV blocks with
+            per-(position, head) f32 scales, dequantized inside the
+            paged gather — ~4x KV capacity at the same HBM.  Lossy
+            (streams are NOT bit-exact vs a full-precision engine);
+            forces the gather decode path and excludes disaggregated
+            migration (``migrate``/``adopt``).
         spec: optional :class:`~bigdl_tpu.serving.spec.SpecConfig` (or
             an int k) enabling draft-verify speculative decoding: a
             cheap drafter (the target's int8 ``quantize()`` clone by
@@ -490,6 +497,7 @@ class LMServingEngine:
                  platform: Optional[str] = None,
                  donate_cache: bool = True,
                  decode_attn: str = "auto",
+                 kv_quant: Optional[str] = None,
                  name: str = "lm",
                  placement=None,
                  tp_rules=None,
@@ -562,6 +570,10 @@ class LMServingEngine:
                 "a prefill-phase replica (migrate=...) cannot speculate: "
                 "it never decodes — speculation belongs on the decode "
                 "replicas")
+        if kv_quant is not None and migrate is not None:
+            raise ValueError(
+                "kv_quant='int8' excludes disaggregated serving: "
+                "quantized pools do not support chain export/adopt")
         self.max_prefill_chunk_tokens = None
         self._chunk_cap = None
         if max_prefill_chunk_tokens is not None:
@@ -585,7 +597,10 @@ class LMServingEngine:
         dt = self._params["embed"].dtype
         self.pool = BlockPool(n_layers=L, n_heads=H, head_dim=D,
                               block_len=self.block_len,
-                              num_blocks=num_blocks, dtype=dt)
+                              num_blocks=num_blocks, dtype=dt,
+                              kv_quant=kv_quant)
+        self.kv_quant = self.pool.kv_quant
+        _kvq = self.kv_quant is not None
         if placement is not None:
             # KV arenas live replicated on the slot: every TP device
             # attends over the full (sharded-head math happens on the
@@ -594,6 +609,9 @@ class LMServingEngine:
             _rep = placement.replicated()
             self.pool.k = jax.device_put(self.pool.k, _rep)
             self.pool.v = jax.device_put(self.pool.v, _rep)
+            if _kvq:
+                self.pool.ks = jax.device_put(self.pool.ks, _rep)
+                self.pool.vs = jax.device_put(self.pool.vs, _rep)
         self.radix = RadixCache(self.pool) if enable_prefix_cache else None
         self._cache_dtype = dt
         # prefix-chain pad buckets (powers of two up to the table width)
@@ -623,6 +641,11 @@ class LMServingEngine:
 
         def _prefix_prefill_fn(params, buffers, x):
             del buffers
+            if _kvq:
+                return _constrain(_prefill_suffix_parts(
+                    model, dequantize_entry(params), x["ids"],
+                    x["len"] - 1, x["prefix_len"], x["blocks"],
+                    x["k"], x["v"], x["ks"], x["vs"]))
             return _constrain(_prefill_suffix_parts(
                 model, dequantize_entry(params), x["ids"], x["len"] - 1,
                 x["prefix_len"], x["blocks"], x["k"], x["v"]))
@@ -634,7 +657,15 @@ class LMServingEngine:
         if decode_attn not in ("auto", "gather", "paged_kernel"):
             raise ValueError(f"decode_attn must be 'auto', 'gather' or "
                              f"'paged_kernel', got {decode_attn!r}")
-        if decode_attn == "auto":
+        if _kvq:
+            # the Pallas paged kernel reads raw blocks — a quantized
+            # pool's in-gather dequant needs the gather path
+            if decode_attn == "paged_kernel":
+                raise ValueError(
+                    "kv_quant='int8' requires decode_attn='gather' (the "
+                    "Pallas paged kernel reads raw blocks)")
+            decode_attn = "gather"
+        elif decode_attn == "auto":
             # the same crossover discipline as flash_attention: the
             # kernel only on tuned evidence for this device kind, the
             # proven XLA gather otherwise
@@ -645,17 +676,27 @@ class LMServingEngine:
                            else "gather")
         self.decode_attn = decode_attn
 
-        def _decode_fn(params, token, pos, tables, kc, vc):
-            return _constrain(_decode_step_paged(
-                model, dequantize_entry(params), token, pos, tables, kc, vc,
-                attn_impl=decode_attn))
+        if _kvq:
+            def _decode_fn(params, token, pos, tables, kc, vc, ks, vs):
+                return _constrain(_decode_step_paged(
+                    model, dequantize_entry(params), token, pos, tables,
+                    kc, vc, ks, vs, attn_impl=decode_attn))
 
-        donate = (4, 5) if donate_cache else ()
+            donate = (4, 5, 6, 7) if donate_cache else ()
+        else:
+            def _decode_fn(params, token, pos, tables, kc, vc):
+                return _constrain(_decode_step_paged(
+                    model, dequantize_entry(params), token, pos, tables,
+                    kc, vc, attn_impl=decode_attn))
+
+            donate = (4, 5) if donate_cache else ()
         self._decode_jit = jax.jit(_decode_fn, donate_argnums=donate)
         self._decode_exec = None
 
+        _insert_donate = ((0, 1, 5, 6) if _kvq else (0, 1))
         self._insert_jit = jax.jit(
-            _insert_blocks, donate_argnums=(0, 1) if donate_cache else ())
+            _insert_blocks,
+            donate_argnums=_insert_donate if donate_cache else ())
         self._insert_execs: dict = {}
 
         # -- speculation (draft-verify) --------------------------------- #
@@ -666,7 +707,7 @@ class LMServingEngine:
         self._verify_exec = None
         self._verify_compiles = 0
         if spec is not None:
-            from bigdl_tpu.quant import params_dtype_tag
+            from bigdl_tpu.quant import params_dtype_tag, set_compute_mode
             from bigdl_tpu.serving.spec import (DraftModel, SpecConfig,
                                                 SpecMetrics)
             if isinstance(spec, int):
@@ -675,10 +716,24 @@ class LMServingEngine:
             draft_lm = spec.draft
             if draft_lm is None:
                 # derive the default drafter: the target's int8 clone
-                # (or the target itself when it is already quantized)
-                draft_lm = (model
-                            if params_dtype_tag(model.params) == "int8"
-                            else model.quantize("int8"))
+                # (or the target itself when it is already quantized),
+                # running the kernels spec.drafter_compute asks for —
+                # drafter numerics only move the acceptance rate, the
+                # emitted stream is the target's under "replay"
+                comp = getattr(spec, "drafter_compute", "dequant")
+                if params_dtype_tag(model.params) == "int8":
+                    draft_lm = model
+                    if comp != "dequant":
+                        # aux-only rewrite: the clone shares every int8
+                        # buffer with the target, only the compute tag
+                        # (pytree aux) differs
+                        draft_lm = model.clone_module()
+                        draft_lm.params = set_compute_mode(
+                            model.params, comp)
+                        draft_lm.grad_params = None
+                        draft_lm = draft_lm.evaluate()
+                else:
+                    draft_lm = model.quantize("int8", compute=comp)
             if draft_lm.vocab_size != model.vocab_size:
                 raise ValueError(
                     f"draft model vocab ({draft_lm.vocab_size}) differs "
@@ -690,15 +745,30 @@ class LMServingEngine:
                 max_cache_entries=max_cache_entries,
                 sampling=spec.sampling, placement_tag=_ptag)
             self.spec_metrics = SpecMetrics().publish_to(get_registry())
+            self.spec_metrics.compute_mode = self.draft.compute_mode
+            _drep = getattr(draft_lm, "quant_report", None) or {}
+            self.spec_metrics.overflow_risk = float(
+                _drep.get("overflow_risk") or 0.0)
 
-            def _verify_fn(params, tokens, pos, n_cand, tables, kc, vc):
-                return _constrain(_verify_step_paged(
-                    model, dequantize_entry(params), tokens, pos, n_cand,
-                    tables, kc, vc))
+            if _kvq:
+                def _verify_fn(params, tokens, pos, n_cand, tables, kc,
+                               vc, ks, vs):
+                    return _constrain(_verify_step_paged(
+                        model, dequantize_entry(params), tokens, pos,
+                        n_cand, tables, kc, vc, ks, vs))
 
+                _vdonate = (5, 6, 7, 8)
+            else:
+                def _verify_fn(params, tokens, pos, n_cand, tables, kc,
+                               vc):
+                    return _constrain(_verify_step_paged(
+                        model, dequantize_entry(params), tokens, pos,
+                        n_cand, tables, kc, vc))
+
+                _vdonate = (5, 6)
             self._verify_jit = jax.jit(
                 _verify_fn,
-                donate_argnums=(5, 6) if donate_cache else ())
+                donate_argnums=_vdonate if donate_cache else ())
 
         self.metrics = (metrics if metrics is not None
                         else LMMetrics(self.slots)).publish_to(
@@ -830,12 +900,14 @@ class LMServingEngine:
         inputs = []
         for b in sb:
             for pb in pbs:
-                inputs.append({
-                    "ids": _np.zeros((1, b), _np.int32),
-                    "len": _np.int32(b),
-                    "prefix_len": _np.int32(pb * self.block_len),
-                    "blocks": _np.zeros((pb,), _np.int32),
-                    "k": self.pool.k, "v": self.pool.v})
+                x = {"ids": _np.zeros((1, b), _np.int32),
+                     "len": _np.int32(b),
+                     "prefix_len": _np.int32(pb * self.block_len),
+                     "blocks": _np.zeros((pb,), _np.int32),
+                     "k": self.pool.k, "v": self.pool.v}
+                if self.kv_quant is not None:
+                    x["ks"], x["vs"] = self.pool.ks, self.pool.vs
+                inputs.append(x)
         return self.prefix_prefill_cache.warmup_inputs(
             self._params, self._buffers, inputs)
 
@@ -852,9 +924,11 @@ class LMServingEngine:
             tok = sds((self.slots,), np.int32, **sh)
             pos = sds((self.slots,), np.int32, **sh)
             tables = sds((self.slots, self.table_width), np.int32, **sh)
-            self._decode_exec = self._decode_jit.lower(
-                self._params, tok, pos, tables,
-                self.pool.k, self.pool.v).compile()
+            args = [self._params, tok, pos, tables,
+                    self.pool.k, self.pool.v]
+            if self.kv_quant is not None:
+                args += [self.pool.ks, self.pool.vs]
+            self._decode_exec = self._decode_jit.lower(*args).compile()
         return self._decode_exec
 
     def _verify_compiled(self):
@@ -871,9 +945,11 @@ class LMServingEngine:
             pos = sds((self.slots,), np.int32, **sh)
             ncand = sds((self.slots,), np.int32, **sh)
             tables = sds((self.slots, self.table_width), np.int32, **sh)
-            self._verify_exec = self._verify_jit.lower(
-                self._params, tok, pos, ncand, tables,
-                self.pool.k, self.pool.v).compile()
+            args = [self._params, tok, pos, ncand, tables,
+                    self.pool.k, self.pool.v]
+            if self.kv_quant is not None:
+                args += [self.pool.ks, self.pool.vs]
+            self._verify_exec = self._verify_jit.lower(*args).compile()
             self._verify_compiles += 1
         return self._verify_exec
 
@@ -886,11 +962,16 @@ class LMServingEngine:
             sds = jax.ShapeDtypeStruct
             sh = (dict(sharding=self.placement.replicated())
                   if self.placement is not None else {})
+            # fresh chunk rows arrive in the model's compute dtype even
+            # when the pool stores int8 (_insert_blocks quantizes them)
             new = sds((L, 1, H, bucket, D), self._cache_dtype, **sh)
-            exe = self._insert_jit.lower(
-                sds(self.pool.shape, self._cache_dtype, **sh),
-                sds(self.pool.shape, self._cache_dtype, **sh),
-                new, new, sds((nb,), np.int32, **sh)).compile()
+            args = [sds(self.pool.shape, self.pool.dtype, **sh),
+                    sds(self.pool.shape, self.pool.dtype, **sh),
+                    new, new, sds((nb,), np.int32, **sh)]
+            if self.kv_quant is not None:
+                scale = sds(self.pool.shape[:4], np.float32, **sh)
+                args += [scale, scale]
+            exe = self._insert_jit.lower(*args).compile()
             self._insert_execs[bucket] = exe
         return exe
 
@@ -1357,11 +1438,13 @@ class LMServingEngine:
                 pb = self._prefix_bucket_for(nbp)
                 pblocks = np.zeros((pb,), np.int32)
                 pblocks[:nbp] = blocks[:nbp]
-                logits, k, v = self.prefix_prefill_cache(
-                    self._params, self._buffers,
-                    {"ids": ids, "len": np.int32(ts),
+                x = {"ids": ids, "len": np.int32(ts),
                      "prefix_len": np.int32(p), "blocks": pblocks,
-                     "k": self.pool.k, "v": self.pool.v})
+                     "k": self.pool.k, "v": self.pool.v}
+                if self.kv_quant is not None:
+                    x["ks"], x["vs"] = self.pool.ks, self.pool.vs
+                logits, k, v = self.prefix_prefill_cache(
+                    self._params, self._buffers, x)
         # scatter the chunk's k/v into its (block-aligned) blocks;
         # bucket-padding rows land in trailing owned blocks or the
         # scratch block, always masked until overwritten
@@ -1371,8 +1454,14 @@ class LMServingEngine:
         ids_w[:len(owned)] = owned
         with _tracer.span("lm/insert", cat="serve", slot=pf.slot,
                           bucket=bucket, **rid_args):
-            self.pool.k, self.pool.v = self._insert_compiled(bucket)(
-                self.pool.k, self.pool.v, k, v, ids_w)
+            if self.kv_quant is not None:
+                (self.pool.k, self.pool.v, self.pool.ks,
+                 self.pool.vs) = self._insert_compiled(bucket)(
+                    self.pool.k, self.pool.v, k, v, ids_w,
+                    self.pool.ks, self.pool.vs)
+            else:
+                self.pool.k, self.pool.v = self._insert_compiled(bucket)(
+                    self.pool.k, self.pool.v, k, v, ids_w)
         self._prefill_since_step = True
         pf.logits = logits
         pf.p = p + ts
@@ -1465,9 +1554,15 @@ class LMServingEngine:
         t0 = time.perf_counter()
         with _tracer.span("lm/decode_step", cat="serve",
                           active=len(active)):
-            logits, self.pool.k, self.pool.v = self._decode_compiled()(
-                self._params, token, pos, tables, self.pool.k,
-                self.pool.v)
+            if self.kv_quant is not None:
+                (logits, self.pool.k, self.pool.v, self.pool.ks,
+                 self.pool.vs) = self._decode_compiled()(
+                    self._params, token, pos, tables, self.pool.k,
+                    self.pool.v, self.pool.ks, self.pool.vs)
+            else:
+                logits, self.pool.k, self.pool.v = self._decode_compiled()(
+                    self._params, token, pos, tables, self.pool.k,
+                    self.pool.v)
             logits = np.asarray(logits)  # sync; (S, V) f32
         now = time.perf_counter()
         if _tracer.enabled:
@@ -1601,9 +1696,15 @@ class LMServingEngine:
         t0 = time.perf_counter()
         with _tracer.span("lm/verify_step", cat="serve",
                           active=len(active), speculating=len(jobs)):
-            logits, self.pool.k, self.pool.v = self._verify_compiled()(
-                self._params, tokens, pos, ncand, tables,
-                self.pool.k, self.pool.v)
+            if self.kv_quant is not None:
+                (logits, self.pool.k, self.pool.v, self.pool.ks,
+                 self.pool.vs) = self._verify_compiled()(
+                    self._params, tokens, pos, ncand, tables,
+                    self.pool.k, self.pool.v, self.pool.ks, self.pool.vs)
+            else:
+                logits, self.pool.k, self.pool.v = self._verify_compiled()(
+                    self._params, tokens, pos, ncand, tables,
+                    self.pool.k, self.pool.v)
             logits = np.asarray(logits)  # sync; (S, W, V) f32
         now = time.perf_counter()
         if _tracer.enabled:

@@ -68,7 +68,8 @@ class DraftModel:
         import jax.numpy as jnp
         from bigdl_tpu.models.transformer.generate import (
             _decode_step_slots, _prefill_parts)
-        from bigdl_tpu.quant import dequantize_entry, params_dtype_tag
+        from bigdl_tpu.quant import (dequantize_entry, params_compute_tag,
+                                     params_dtype_tag)
 
         model._built()
         self.model = model
@@ -85,6 +86,7 @@ class DraftModel:
         self._params = model.params
         self._buffers = model.buffers
         self.dtype_tag = params_dtype_tag(self._params) or "f32"
+        self.compute_mode = params_compute_tag(self._params) or "f32"
         L = model.n_layers
         H, D = model._mha.n_head, model._mha.head_dim
         dt = self._params["embed"].dtype
@@ -269,6 +271,7 @@ class DraftModel:
     # -- reading -------------------------------------------------------- #
     def describe(self) -> dict:
         return {"dtype_tag": self.dtype_tag,
+                "compute_mode": self.compute_mode,
                 "hidden": self.model.hidden_size,
                 "layers": self.model.n_layers,
                 "cache_len": self.cache_len,

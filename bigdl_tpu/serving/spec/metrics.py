@@ -25,6 +25,12 @@ class SpecMetrics:
         self.demotions = 0        # EMA-collapse demotions
         self.fault_demotions = 0  # injected-transient demotions
         self.reprobes = 0         # demoted slots re-probed
+        # drafter kernel regime ("dequant"/"int8"/"auto"/"fp8"/"f32")
+        # and its worst-layer int32-accumulator overflow-risk gauge
+        # (max |q_w| * 127 * K / 2^31 — see quant.transform); the
+        # engine stamps both after building the drafter
+        self.compute_mode = "f32"
+        self.overflow_risk = 0.0
         self.acceptance = Histogram()  # per-(slot, round) acceptance rate
 
     def publish_to(self, registry,
@@ -45,6 +51,10 @@ class SpecMetrics:
             replace=True)
         registry.register(prefix + "acceptance", self.acceptance,
                           replace=True)
+        registry.register(prefix + "compute_mode",
+                          FnGauge(lambda: self.compute_mode), replace=True)
+        registry.register(prefix + "overflow_risk",
+                          FnGauge(lambda: self.overflow_risk), replace=True)
         return self
 
     # -- recording ------------------------------------------------------ #
@@ -91,6 +101,8 @@ class SpecMetrics:
                 "demotions": self.demotions,
                 "fault_demotions": self.fault_demotions,
                 "reprobes": self.reprobes,
+                "compute_mode": self.compute_mode,
+                "overflow_risk": self.overflow_risk,
                 "acceptance_rate":
                     (self.accepted / self.drafted) if self.drafted else None,
                 "draft_overhead":

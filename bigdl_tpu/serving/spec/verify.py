@@ -68,6 +68,7 @@ class SpecConfig:
     """
 
     def __init__(self, k: int = 4, *, draft=None, sampling: str = "replay",
+                 drafter_compute: str = "dequant",
                  ema_alpha: float = 0.3, demote_below: float = 0.1,
                  min_rounds: int = 4, probe_interval: int = 8):
         self.k = int(k)
@@ -76,8 +77,19 @@ class SpecConfig:
         if sampling not in SAMPLING_MODES:
             raise ValueError(f"sampling must be one of {SAMPLING_MODES}, "
                              f"got {sampling!r}")
+        if drafter_compute not in ("dequant", "int8", "auto"):
+            raise ValueError(
+                "drafter_compute must be 'dequant', 'int8' or 'auto', "
+                f"got {drafter_compute!r}")
         self.draft = draft
         self.sampling = sampling
+        # kernel regime for the DEFAULT drafter (the target's int8
+        # clone): "dequant" keeps weight-only dequant-on-the-fly,
+        # "int8" feeds int8 activations x int8 weights to the MXU,
+        # "auto" follows the measured duel in ops/autotune.py.  Drafter
+        # numerics only move acceptance — emitted tokens are the
+        # target's under "replay".  Ignored when ``draft`` is given.
+        self.drafter_compute = drafter_compute
         self.ema_alpha = float(ema_alpha)
         if not 0.0 < self.ema_alpha <= 1.0:
             raise ValueError(f"ema_alpha must be in (0, 1], got {ema_alpha}")
@@ -92,6 +104,7 @@ class SpecConfig:
 
     def describe(self) -> dict:
         return {"k": self.k, "sampling": self.sampling,
+                "drafter_compute": self.drafter_compute,
                 "ema_alpha": self.ema_alpha,
                 "demote_below": self.demote_below,
                 "min_rounds": self.min_rounds,

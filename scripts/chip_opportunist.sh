@@ -60,6 +60,7 @@ if [ "$SMOKE" = "1" ]; then
   SCAN_ITERS=1; SCAN_STEPS=2
   SERVE_LM_ARGS="--requests 6 --slots 2 --cache-len 64 --mean-gap-ms 5 --probes 1"
   SPEC_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1"
+  QCOMPUTE_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1 --duel-iters 2"
   PREFIX_ARGS="--requests 6 --slots 2 --cache-len 96 --shared-len 32 --mean-gap-ms 5 --probes 1"
   DISAGG_ARGS="--requests 8 --slots 4 --cache-len 128 --chunk-tokens 16 --mean-gap-ms 5 --probes 1"
   SLO_ARGS="--loads 4,8 --duration 1.5 --chaos-duration 2 --chaos-rps 15 --slots 2 --cache-len 64"
@@ -82,6 +83,7 @@ else
   SCAN_ITERS=3; SCAN_STEPS=8
   SERVE_LM_ARGS="--requests 48 --slots 8 --cache-len 128"
   SPEC_ARGS="--requests 24 --slots 8 --cache-len 128"
+  QCOMPUTE_ARGS="--requests 24 --slots 8 --cache-len 128"
   PREFIX_ARGS="--requests 24 --slots 8 --cache-len 128 --shared-len 64"
   DISAGG_ARGS="--requests 24 --slots 8 --cache-len 128 --chunk-tokens 32"
   SLO_ARGS="--loads 4,8,16,32,64 --duration 5 --chaos-duration 8"
@@ -121,7 +123,7 @@ PYEOF
 ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json TUNE_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
 BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json BENCH_MESH.json \
-BENCH_SPEC.json BENCH_DISAGG.json \
+BENCH_SPEC.json BENCH_DISAGG.json BENCH_QCOMPUTE.json \
 FLIGHT_*.json TRACE_*.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
@@ -310,6 +312,29 @@ spec_stage() {
   return 1
 }
 
+# qcompute rides right after spec: same spec trace, but the drafter
+# runs TRUE int8 compute (int8xint8 MXU dot, int32 accumulate) vs the
+# dequant-bf16 regime, plus the kernel duel that feeds compute="auto"
+# through the shared tuning cache.  On a real chip the duel verdicts
+# become MXU evidence instead of the repo's CPU-proven rows — which is
+# the whole point of the artifact.  Same ok_lm gate (the committed CPU
+# BENCH_QCOMPUTE.json must never mark the TPU stage done) and the same
+# never-gates-the-round contract.  Duel transfers are tiny (< 1 MB),
+# far below the 32 MB relay ceiling.
+qcompute_stage() {
+  ok_lm BENCH_QCOMPUTE.json && return 0
+  say "stage qcompute: firing (budget 600s): python -u bench.py --serve-lm --spec --qcompute $QCOMPUTE_ARGS"
+  timeout 600 python -u bench.py --serve-lm --spec --qcompute $QCOMPUTE_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm BENCH_QCOMPUTE.json; then
+    say "stage qcompute: DONE"
+    return 0
+  fi
+  say "stage qcompute: not done (rc=$rc)"
+  record_incident qcompute "$rc"
+  return 1
+}
+
 # mesh rides right after serve-lm: it proves the placement subsystem
 # against the REAL device set (TP-slot carving + sharded param staging
 # through the chunked relay discipline) — on a multi-chip window the
@@ -460,6 +485,7 @@ while :; do
     autotune_stage
     serve_lm_stage
     spec_stage
+    qcompute_stage
     mesh_stage
     prefix_stage
     disagg_stage

@@ -192,6 +192,56 @@ def _disagg_problems(doc) -> list:
     return probs
 
 
+def _qcompute_problems(doc) -> list:
+    """BENCH_QCOMPUTE.json extras: the int8-compute proof has two row
+    families — ``duel:*`` kernel-duel rows (must carry a numeric
+    ``step_s``; a non-numeric duel row means the autotune verdict the
+    ``spec_auto`` stage traced against was never measured) and serving
+    stages, where every ``spec_*`` replay stage must stream the offline
+    trajectory exactly (agreement == 1.0 — drafter numerics must never
+    reach the emitted stream, whatever kernels it runs)."""
+    probs = []
+    if doc.get("error"):
+        return probs
+    for i, r in enumerate(doc.get("rows", [])):
+        if not isinstance(r, dict):
+            continue
+        stage = r.get("stage")
+        if stage is None:
+            probs.append("qcompute row %d lacks a 'stage' key" % i)
+            continue
+        if str(stage).startswith("duel:"):
+            if not isinstance(r.get("step_s"), (int, float)):
+                probs.append("qcompute duel row %d (%s) lacks numeric "
+                             "step_s" % (i, stage))
+        elif str(stage).startswith("spec_"):
+            if doc.get("complete") is True \
+                    and r.get("agreement") != 1.0:
+                probs.append("complete qcompute artifact: row %d (%s) "
+                             "agreement must be exactly 1.0, got %r"
+                             % (i, stage, r.get("agreement")))
+            a = r.get("accept_rate")
+            if a is not None and (not isinstance(a, (int, float))
+                                  or not 0.0 <= a <= 1.0):
+                probs.append("qcompute row %d (%s): 'accept_rate' must "
+                             "be a fraction in [0, 1], got %r"
+                             % (i, stage, a))
+    if doc.get("complete") is True:
+        summ = doc.get("summary")
+        if not isinstance(summ, dict):
+            probs.append("complete qcompute artifact lacks a summary")
+            return probs
+        if summ.get("agreement") not in (1.0, None):
+            probs.append("complete qcompute artifact: summary.agreement "
+                         "must be exactly 1.0 (or null when unprobed), "
+                         "got %r" % (summ.get("agreement"),))
+        if not isinstance(summ.get("auto_verdicts"), dict):
+            probs.append("complete qcompute artifact lacks "
+                         "summary.auto_verdicts (the duel outcomes "
+                         "'auto' traced against)")
+    return probs
+
+
 def _problems(doc, name: str = "") -> list:
     """Contract violations for one parsed artifact document."""
     probs = []
@@ -225,6 +275,8 @@ def _problems(doc, name: str = "") -> list:
             probs.extend(_spec_problems(doc))
         if name == "BENCH_DISAGG.json":
             probs.extend(_disagg_problems(doc))
+        if name == "BENCH_QCOMPUTE.json":
+            probs.extend(_qcompute_problems(doc))
         return probs
     if "metric" not in doc:
         probs.append("no 'rows', no supervisor record, no 'metric' key "
