@@ -2049,6 +2049,307 @@ def _serve_lm_prefix_bench(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --serve-lm --kvtier: host-tier KV offload + hibernation -> BENCH_KVTIER.json
+# ---------------------------------------------------------------------------
+
+def _serve_lm_kvtier_bench(argv) -> int:
+    """Host-tier KV offload benchmark -> BENCH_KVTIER.json (resumable).
+
+    Three stages, one fresh engine + HostBlockStore each:
+
+    - ``hibernate_exact``: per-probe hibernate/resume mid-decode vs an
+      uninterrupted reference run — half the probes also lose their
+      session payload on purpose (the prompt-re-prefill + decode-replay
+      fallback leg).  AGREEMENT artifact: ``complete`` requires the
+      stage's agreement to be exactly 1.0 — a tiered memory that
+      changes even one token is not a memory tier, it is a bug.
+    - ``resume_vs_reprefill``: TTFT-on-resume (resume() -> next fresh
+      token, chain promoted through the 32 MB chunked transfer) vs the
+      cold full-prefill TTFT at the same prompt length, plus the
+      promote bandwidth.  On CPU the resume must win for the artifact
+      to certify.
+    - ``oversubscribed``: a 10x-oversubscribed session trace over a
+      ~3-chain pool, replayed twice — demoted prefix tails must be
+      re-admitted from the tier with a NONZERO hit rate.
+
+    Same resumable-artifact contract as the other serving benches:
+    a row flushes after every stage, ``complete: false`` until the
+    final gate-checked flush."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --serve-lm --kvtier")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--probes", type=int, default=int(
+        os.environ.get("BIGDL_TPU_KVTIER_PROBES", "6")))
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--cache-len", type=int, default=128)
+    ap.add_argument("--block-len", type=int, default=16)
+    ap.add_argument("--sessions", type=int, default=20,
+                    help="oversubscribed-stage session count (10x the "
+                         "2 decode slots by default)")
+    ap.add_argument("--rounds", type=int, default=2,
+                    help="oversubscribed-stage trace replays")
+    ap.add_argument("--timing-samples", type=int, default=3)
+    args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_KVTIER.json")
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import jax
+    import numpy as np
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.serving import HostBlockStore, LMServingEngine
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    config = {"model": "transformer_lm", "vocab": 256, "hidden": 128,
+              "heads": 4, "layers": 4, "max_len": args.cache_len,
+              "pos": "rope", "slots": args.slots,
+              "cache_len": args.cache_len,
+              "layout": "paged", "block_len": args.block_len,
+              "probes": args.probes, "sessions": args.sessions,
+              "rounds": args.rounds,
+              "timing_samples": args.timing_samples}
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "lm_serving_kvtier", "platform": platform,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+    model = TransformerLM(
+        vocab_size=config["vocab"], hidden_size=config["hidden"],
+        n_head=config["heads"], n_layers=config["layers"],
+        max_len=args.cache_len, pos_encoding="rope").build(seed=7)
+    eng_kw = dict(slots=args.slots, cache_len=args.cache_len,
+                  block_len=args.block_len,
+                  max_queue=max(args.sessions * args.rounds, 256))
+
+    def _hibernate_exact_stage():
+        rng = np.random.RandomState(3)
+        plen = max(args.block_len + 1, args.cache_len // 4)
+        max_new = min(48, args.cache_len - plen)
+        prompts = [rng.randint(1, config["vocab"] + 1,
+                               size=plen).astype(np.int32)
+                   for _ in range(args.probes)]
+        ref_eng = LMServingEngine(model, **eng_kw)
+        try:
+            ref_eng.warmup()
+            refs = [ref_eng.generate(p, max_new_tokens=max_new,
+                                     temperature=0.7, rng=i,
+                                     timeout=600)
+                    for i, p in enumerate(prompts)]
+        finally:
+            ref_eng.close()
+        tier = HostBlockStore(host_bytes=256 << 20, name="bench-hib")
+        eng = LMServingEngine(model, kvtier=tier, **eng_kw)
+        try:
+            eng.warmup()
+            exact = hibernated = forced_lost = 0
+            for i, p in enumerate(prompts):
+                st = eng.submit(p, max_new_tokens=max_new,
+                                temperature=0.7, rng=i)
+                it = st.tokens(timeout=600)
+                next(it)
+                next(it)
+                if eng.hibernate(st):
+                    hibernated += 1
+                    if i % 2 == 1:
+                        # odd probes lose their payload: exercises the
+                        # re-prefill + decode-replay fallback leg
+                        if tier.get(("session", st.request_id),
+                                    pop=True) is not None:
+                            forced_lost += 1
+                    eng.resume(st)
+                out = st.result(timeout=600)
+                exact += int(np.array_equal(out, refs[i]))
+            return {"probes": args.probes,
+                    "agreement": round(exact / args.probes, 4),
+                    "hibernated": hibernated,
+                    "forced_lost_payloads": forced_lost,
+                    "lost_payload_resumes": eng.resume_re_prefills,
+                    "tier": tier.stats()}
+        finally:
+            eng.close()
+
+    def _resume_vs_reprefill_stage():
+        tier = HostBlockStore(host_bytes=256 << 20, name="bench-resume")
+        eng = LMServingEngine(model, kvtier=tier, **eng_kw)
+        try:
+            eng.warmup()
+            plen = args.cache_len - 16
+            max_new = min(32, args.cache_len - plen)
+            depth = max(2, 3 * max_new // 4)
+            rng = np.random.RandomState(5)
+
+            def cycle(lose_payload):
+                # hibernate ``depth`` tokens into decode, then time
+                # resume() -> the next FRESH token.  The payload-lost
+                # leg is the engine's own fallback: re-prefill the
+                # prompt + replay the emitted tokens through decode
+                # steps — the exact cost the host tier avoids.
+                q = rng.randint(1, config["vocab"] + 1,
+                                size=plen).astype(np.int32)
+                st = eng.submit(q, max_new_tokens=max_new)
+                it = st.tokens(timeout=600)
+                for _ in range(depth):
+                    next(it)
+                if not eng.hibernate(st):
+                    st.result(timeout=600)
+                    return None
+                for _ in range(len(st.generated) - depth):
+                    next(it)       # drain the hibernate-race tokens
+                if lose_payload:
+                    tier.get(("session", st.request_id), pop=True)
+                t0 = time.perf_counter()
+                eng.resume(st)
+                next(it)           # blocks on the stream cv, no poll
+                dt = time.perf_counter() - t0
+                st.result(timeout=600)
+                return dt
+
+            # warmup cycles on BOTH legs: pay the adopt-scatter /
+            # prefill-bucket compiles so the timed samples measure
+            # the work, not XLA
+            for _ in range(2):
+                cycle(False)
+                cycle(True)
+            resume_s = [t for t in (cycle(False) for _ in
+                                    range(args.timing_samples))
+                        if t is not None]
+            reprefill_s = [t for t in (cycle(True) for _ in
+                                       range(args.timing_samples))
+                           if t is not None]
+            # best-of: residual jit noise lands on the first sample of
+            # a new chain shape; min is the steady-state cost
+            best = lambda xs: (round(float(min(xs)) * 1000.0, 3)
+                               if xs else None)  # noqa: E731
+            row = {"prompt_len": plen, "hibernate_depth": depth,
+                   "ttft_resume_ms": best(resume_s),
+                   "ttft_reprefill_ms": best(reprefill_s),
+                   "resume_samples": len(resume_s),
+                   "reprefill_samples": len(reprefill_s),
+                   "promote_mbs": tier.promote_bandwidth_mbs(),
+                   "tier": tier.stats(),
+                   "lost_payload_resumes": eng.resume_re_prefills}
+            if row["ttft_resume_ms"] and row["ttft_reprefill_ms"]:
+                row["resume_speedup"] = round(
+                    row["ttft_reprefill_ms"] / row["ttft_resume_ms"], 3)
+            return row
+        finally:
+            eng.close()
+
+    def _oversubscribed_stage():
+        tier = HostBlockStore(host_bytes=256 << 20, name="bench-over")
+        B = args.block_len
+        plen, max_new = 4 * B + 1, 8
+        # pool sized to exactly 2 live chains; radix retention from
+        # finished sessions overflows it fast, so tails demote
+        need = -(-(plen + max_new) // B)
+        eng = LMServingEngine(model, slots=2, cache_len=args.cache_len,
+                              block_len=args.block_len,
+                              num_blocks=1 + 2 * need, kvtier=tier,
+                              max_queue=max(args.sessions * args.rounds,
+                                            256))
+        try:
+            eng.warmup()
+            rng = np.random.RandomState(0)
+            head = rng.randint(1, config["vocab"] + 1, size=2 * B)
+            # 4-block + 1 prompts: the evictable leaf block stays
+            # inside the matchable range when the session returns
+            prompts = [np.concatenate(
+                [head, rng.randint(1, config["vocab"] + 1,
+                                   size=2 * B + 1)]).astype(np.int32)
+                for _ in range(args.sessions)]
+            t0 = time.perf_counter()
+            for _ in range(args.rounds):
+                streams = [eng.submit(p, max_new_tokens=max_new)
+                           for p in prompts]
+                for s in streams:
+                    s.result(timeout=600)
+            wall = time.perf_counter() - t0
+            ts = tier.stats()
+            return {"sessions": args.sessions, "rounds": args.rounds,
+                    "wall_s": round(wall, 3),
+                    "pool_blocks": eng.pool.capacity,
+                    "working_set_blocks": need * args.sessions,
+                    "oversubscription": round(
+                        need * args.sessions / eng.pool.capacity, 2),
+                    "prefix_hit_rate": ts["hit_rate"],
+                    "tier": ts,
+                    "radix": eng.stats()["kvcache"]["prefix_cache"]}
+        finally:
+            eng.close()
+
+    stages = {"hibernate_exact": _hibernate_exact_stage,
+              "resume_vs_reprefill": _resume_vs_reprefill_stage,
+              "oversubscribed": _oversubscribed_stage}
+    for name, run in stages.items():
+        if name in prev:
+            row = dict(prev[name])
+            row["reused_from_previous_run"] = True
+        else:
+            row = {"stage": name, **run()}
+        rows.append(row)
+        flush()
+
+    hib = next(r for r in rows if r.get("stage") == "hibernate_exact")
+    rvs = next(r for r in rows
+               if r.get("stage") == "resume_vs_reprefill")
+    over = next(r for r in rows if r.get("stage") == "oversubscribed")
+    problems = []
+    if hib["agreement"] != 1.0:
+        problems.append("hibernate/resume agreement %r != 1.0 — "
+                        "resumed streams diverged" % (hib["agreement"],))
+    if not over["prefix_hit_rate"]:
+        problems.append("oversubscribed trace never hit the host tier")
+    if (platform == "cpu" and rvs.get("ttft_resume_ms")
+            and rvs.get("ttft_reprefill_ms")
+            and rvs["ttft_resume_ms"] >= rvs["ttft_reprefill_ms"]):
+        problems.append(
+            "TTFT-on-resume (%.1f ms) did not beat re-prefill "
+            "(%.1f ms) on cpu" % (rvs["ttft_resume_ms"],
+                                  rvs["ttft_reprefill_ms"]))
+    if problems:
+        for p in problems:
+            print("bench: KVTIER GATE: " + p + " — artifact left "
+                  "incomplete", file=sys.stderr)
+        flush()
+        return 1
+    result["summary"] = {
+        "agreement": hib["agreement"],
+        "lost_payload_resumes": hib["lost_payload_resumes"],
+        "ttft_resume_ms": rvs.get("ttft_resume_ms"),
+        "ttft_reprefill_ms": rvs.get("ttft_reprefill_ms"),
+        "resume_speedup": rvs.get("resume_speedup"),
+        "promote_mbs": rvs.get("promote_mbs"),
+        "prefix_hit_rate": over["prefix_hit_rate"],
+        "oversubscription": over["oversubscription"],
+    }
+    result["complete"] = True
+    flush()
+    print(json.dumps({
+        "metric": "lm_serving_kvtier_resume_ttft_ms",
+        "value": rvs.get("ttft_resume_ms"),
+        "unit": "ms", "platform": platform,
+        **{k: v for k, v in result["summary"].items()
+           if k != "ttft_resume_ms"}}), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --serve-lm --disagg: disaggregated prefill/decode -> BENCH_DISAGG.json
 # ---------------------------------------------------------------------------
 
@@ -2742,6 +3043,10 @@ if __name__ == "__main__":
         sys.exit(_serve_lm_qcompute_bench(
             [a for a in sys.argv[1:]
              if a not in ("--serve-lm", "--spec", "--qcompute")]))
+    if "--serve-lm" in sys.argv and "--kvtier" in sys.argv:
+        sys.exit(_serve_lm_kvtier_bench(
+            [a for a in sys.argv[1:]
+             if a not in ("--serve-lm", "--kvtier")]))
     if "--serve-lm" in sys.argv and "--spec" in sys.argv:
         sys.exit(_serve_lm_spec_bench(
             [a for a in sys.argv[1:]

@@ -29,6 +29,7 @@ from bigdl_tpu.serving.engine import ServingEngine
 from bigdl_tpu.serving.host_transfer import HostStager
 from bigdl_tpu.serving.kvcache import (BlockPool, PoolExhausted, RadixCache,
                                        RequestExceedsPool)
+from bigdl_tpu.serving.kvtier import HostBlockStore
 from bigdl_tpu.serving.lm_engine import (KVHandoff, LMMetrics,
                                          LMServingEngine, LMStream,
                                          prefill_bucket_lengths)
@@ -46,6 +47,7 @@ __all__ = [
     "LMServingEngine", "LMStream", "LMMetrics", "prefill_bucket_lengths",
     "DisaggCoordinator", "KVHandoff",
     "BlockPool", "RadixCache", "PoolExhausted", "RequestExceedsPool",
+    "HostBlockStore",
     "DeviceTopology", "MeshSlice", "MeshSlicer", "PlacementError",
     "PlacementPolicy", "serving_tp_rules", "shard_params_chunked",
     "SpecConfig", "DraftModel", "SpecMetrics",

@@ -84,8 +84,9 @@ class BlockPool:
             paged gather dequantizes in-flight (see
             ``generate._decode_step_paged``); storage drops ~4x minus
             the 1/D scale overhead.  Lossy: streams are NOT bit-exact
-            vs a full-precision pool.  Quantized pools do not support
-            chain migration (export/adopt) yet — disaggregated serving
+            vs a full-precision pool.  Chain export/adopt bundles the
+            scale arrays atomically with the data (the host KV tier
+            and hibernation ride this); disaggregated serving still
             keeps full-precision pools.
 
     The jnp arenas are held as ``self.k`` / ``self.v`` (plus
@@ -209,10 +210,34 @@ class BlockPool:
         L, _, H, B, D = self.shape
         return L * H * B * D * self.dtype.itemsize
 
+    @property
+    def scale_block_bytes(self) -> int:
+        """Bytes of one block's k (== v) per-(position, head) scale
+        rows; 0 for full-precision pools."""
+        if self.ks is None:
+            return 0
+        L, _, H, B, _ = self.shape
+        return L * H * B * self.ks.dtype.itemsize
+
+    @property
+    def wire_block_bytes(self) -> int:
+        """Per-block wire bytes of one k (== v) leg INCLUDING its scale
+        rows — the unit the chunkers budget on, so a quantized block's
+        scales count against the same 32 MB transfer ceiling as its
+        data."""
+        return self.block_bytes + self.scale_block_bytes
+
     def export_chain(self, blocks: Sequence[int], *,
                      chunk_bytes: Optional[int] = None) -> dict:
         """Gather ``blocks``' k/v rows to the host as a block-major
         wire payload ``{"k", "v": (n, L, H, block_len, D) np, "blocks": n}``.
+
+        A quantized pool (``kv_quant="int8"``) exports its
+        per-(position, head) scales ATOMICALLY with the data — the
+        payload gains ``"ks"`` / ``"vs"`` arrays shaped ``(n, L, H,
+        block_len)`` f32, and scale bytes count against the chunk
+        budget — so an adopted block is bit-identical to the exported
+        one, never data without its dequantization state.
 
         Device->host moves in slices of at most ``chunk_bytes`` (the
         shared 32 MB transfer ceiling by default) along the block dim,
@@ -223,44 +248,63 @@ class BlockPool:
         import jax.numpy as jnp
         import numpy as np
 
-        if self.kv_quant is not None:
-            raise NotImplementedError(
-                "chain migration is not supported for quantized pools "
-                "(kv_quant='int8'); disaggregated serving keeps "
-                "full-precision pools")
-
         from bigdl_tpu.utils.transfer import DEFAULT_CHUNK_BYTES
         cb = int(chunk_bytes) if chunk_bytes else DEFAULT_CHUNK_BYTES
         n = len(blocks)
         L, _, H, B, D = self.shape
+        quant = self.kv_quant is not None
         host_k = np.empty((n, L, H, B, D), self.dtype)
         host_v = np.empty((n, L, H, B, D), self.dtype)
+        host_ks = np.empty((n, L, H, B), np.float32) if quant else None
+        host_vs = np.empty((n, L, H, B), np.float32) if quant else None
         if n:
             idx = jnp.asarray(list(blocks), jnp.int32)
             # device-side gather + transpose to block-major wire layout
             kc = jnp.moveaxis(self.k[:, idx], 0, 1)
             vc = jnp.moveaxis(self.v[:, idx], 0, 1)
-            rows = max(1, cb // max(1, self.block_bytes))
+            if quant:
+                ksc = jnp.moveaxis(self.ks[:, idx], 0, 1)
+                vsc = jnp.moveaxis(self.vs[:, idx], 0, 1)
+            rows = max(1, cb // max(1, self.wire_block_bytes))
             for i in range(0, n, rows):
                 host_k[i:i + rows] = np.asarray(kc[i:i + rows])
                 host_v[i:i + rows] = np.asarray(vc[i:i + rows])
-        return {"k": host_k, "v": host_v, "blocks": n}
+                if quant:
+                    host_ks[i:i + rows] = np.asarray(ksc[i:i + rows])
+                    host_vs[i:i + rows] = np.asarray(vsc[i:i + rows])
+        out = {"k": host_k, "v": host_v, "blocks": n}
+        if quant:
+            out["ks"] = host_ks
+            out["vs"] = host_vs
+        return out
 
     def _adopt_scatter(self, width: int):
         """Donated scatter of a ``width``-block wire payload into the
         arenas; one executable per padded wire width (powers of two),
-        padded entries target the scratch block with zero rows."""
+        padded entries target the scratch block with zero rows.  A
+        quantized pool's scatter writes data and scale arenas in ONE
+        executable — a block can never land without its scales."""
         exe = self._adopt_jits.get(width)
         if exe is None:
             import jax
             import jax.numpy as jnp
 
-            def _scatter(k, v, kw, vw, ids):
-                k = k.at[:, ids].set(jnp.moveaxis(kw, 0, 1))
-                v = v.at[:, ids].set(jnp.moveaxis(vw, 0, 1))
-                return k, v
+            if self.kv_quant is not None:
+                def _scatter_q(k, v, ks, vs, kw, vw, ksw, vsw, ids):
+                    k = k.at[:, ids].set(jnp.moveaxis(kw, 0, 1))
+                    v = v.at[:, ids].set(jnp.moveaxis(vw, 0, 1))
+                    ks = ks.at[:, ids].set(jnp.moveaxis(ksw, 0, 1))
+                    vs = vs.at[:, ids].set(jnp.moveaxis(vsw, 0, 1))
+                    return k, v, ks, vs
 
-            exe = jax.jit(_scatter, donate_argnums=(0, 1))
+                exe = jax.jit(_scatter_q, donate_argnums=(0, 1, 2, 3))
+            else:
+                def _scatter(k, v, kw, vw, ids):
+                    k = k.at[:, ids].set(jnp.moveaxis(kw, 0, 1))
+                    v = v.at[:, ids].set(jnp.moveaxis(vw, 0, 1))
+                    return k, v
+
+                exe = jax.jit(_scatter, donate_argnums=(0, 1))
             self._adopt_jits[width] = exe
         return exe
 
@@ -284,20 +328,38 @@ class BlockPool:
                 import jax
                 kw = jax.device_put(kw, self.k.sharding)
             idx = np.full((w,), SCRATCH_BLOCK, np.int32)
-            self.k, self.v = self._adopt_scatter(w)(
-                self.k, self.v, kw, kw, idx)
+            if self.kv_quant is not None:
+                sw = jnp.zeros((w,) + self.shape[:1] + self.shape[2:4],
+                               jnp.float32)
+                if getattr(self.ks, "sharding", None) is not None:
+                    import jax
+                    sw = jax.device_put(sw, self.ks.sharding)
+                (self.k, self.v, self.ks,
+                 self.vs) = self._adopt_scatter(w)(
+                    self.k, self.v, self.ks, self.vs, kw, kw, sw, sw, idx)
+            else:
+                self.k, self.v = self._adopt_scatter(w)(
+                    self.k, self.v, kw, kw, idx)
             n += 1
         return n
 
-    def adopt_chain(self, k_wire, v_wire, *, extra_blocks: int = 0,
-                    device=None, chunk_bytes: Optional[int] = None
-                    ) -> List[int]:
+    def adopt_chain(self, k_wire, v_wire, ks_wire=None, vs_wire=None, *,
+                    extra_blocks: int = 0, device=None,
+                    chunk_bytes: Optional[int] = None) -> List[int]:
         """Adopt an exported chain into THIS pool: allocate
         ``n_wire + extra_blocks`` blocks (all-or-nothing — a partial
         adoption would strand a half-migrated sequence), stage the wire
         payload over ``chunked_device_put`` and scatter it into the
         first ``n_wire`` of them.  Returns the new block ids, each at
         refcount 1 (the adopting sequence's references).
+
+        A quantized pool (``kv_quant="int8"``) REQUIRES the matching
+        scale arrays ``ks_wire`` / ``vs_wire`` (shape ``(n, L, H,
+        block_len)``) from :meth:`export_chain` — data and scales land
+        through one donated scatter, and the data legs' chunk budget is
+        shrunk by the scale share so data + scales together respect the
+        32 MB transfer ceiling.  The adopted block is bit-identical to
+        the exported one.
 
         ``extra_blocks`` reserves the generation tail in the same
         atomic allocation.  ``device`` is the arena's committed
@@ -309,12 +371,6 @@ class BlockPool:
         """
         import numpy as np
 
-        if self.kv_quant is not None:
-            raise NotImplementedError(
-                "chain migration is not supported for quantized pools "
-                "(kv_quant='int8'); disaggregated serving keeps "
-                "full-precision pools")
-
         from bigdl_tpu.utils.transfer import (DEFAULT_CHUNK_BYTES,
                                               chunked_device_put)
         k_wire = np.asarray(k_wire)
@@ -323,15 +379,44 @@ class BlockPool:
         if v_wire.shape != k_wire.shape:
             raise ValueError(
                 f"k/v wire shapes differ: {k_wire.shape} vs {v_wire.shape}")
+        quant = self.kv_quant is not None
+        if quant and n and (ks_wire is None or vs_wire is None):
+            raise ValueError(
+                "adopting into a quantized pool (kv_quant='int8') "
+                "requires the ks/vs scale arrays exported with the "
+                "chain — data without scales cannot dequantize")
+        if not quant and (ks_wire is not None or vs_wire is not None):
+            raise ValueError(
+                "scale arrays supplied for a full-precision pool")
+        if quant and n:
+            ks_wire = np.asarray(ks_wire, np.float32)
+            vs_wire = np.asarray(vs_wire, np.float32)
+            want = (n,) + self.shape[:1] + self.shape[2:4]
+            if ks_wire.shape != want or vs_wire.shape != want:
+                raise ValueError(
+                    f"scale wire shapes {ks_wire.shape} / "
+                    f"{vs_wire.shape} do not match blocks {want}")
         ids = self.alloc(n + max(0, int(extra_blocks)))
         if n == 0:
             return ids
         cb = int(chunk_bytes) if chunk_bytes else DEFAULT_CHUNK_BYTES
+        # scale bytes ride the same budget: a data slice plus its scale
+        # slice together stay under ``cb``
+        data_cb = max(1, cb * self.block_bytes
+                      // max(1, self.wire_block_bytes))
         try:
-            kw = chunked_device_put(k_wire, self.dtype, chunk_bytes=cb,
-                                    device=device)
-            vw = chunked_device_put(v_wire, self.dtype, chunk_bytes=cb,
-                                    device=device)
+            kw = chunked_device_put(k_wire, self.dtype,
+                                    chunk_bytes=data_cb, device=device)
+            vw = chunked_device_put(v_wire, self.dtype,
+                                    chunk_bytes=data_cb, device=device)
+            if quant:
+                scale_cb = max(1, cb - data_cb)
+                ksw = chunked_device_put(ks_wire, np.float32,
+                                         chunk_bytes=scale_cb,
+                                         device=device)
+                vsw = chunked_device_put(vs_wire, np.float32,
+                                         chunk_bytes=scale_cb,
+                                         device=device)
             # pad the wire to a power-of-two width so the donated
             # scatter compiles once per bucket; padded rows are zeros
             # aimed at the scratch block (garbage there is masked)
@@ -346,10 +431,24 @@ class BlockPool:
                     pad = jax.device_put(pad, device)
                 kw = jnp.concatenate([kw, pad], axis=0)
                 vw = jnp.concatenate([vw, pad], axis=0)
+                if quant:
+                    spad = jnp.zeros((width - n,) + ksw.shape[1:],
+                                     ksw.dtype)
+                    if device is not None:
+                        import jax
+                        spad = jax.device_put(spad, device)
+                    ksw = jnp.concatenate([ksw, spad], axis=0)
+                    vsw = jnp.concatenate([vsw, spad], axis=0)
             idx = np.full((width,), SCRATCH_BLOCK, np.int32)
             idx[:n] = ids[:n]
-            self.k, self.v = self._adopt_scatter(width)(
-                self.k, self.v, kw, vw, idx)
+            if quant:
+                (self.k, self.v, self.ks,
+                 self.vs) = self._adopt_scatter(width)(
+                    self.k, self.v, self.ks, self.vs, kw, vw, ksw, vsw,
+                    idx)
+            else:
+                self.k, self.v = self._adopt_scatter(width)(
+                    self.k, self.v, kw, vw, idx)
         except BaseException:
             self.release(ids)
             raise

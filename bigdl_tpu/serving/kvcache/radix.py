@@ -28,6 +28,15 @@ Reference protocol (one pool refcount per holder):
   live sequence can never evict, and interior nodes only become
   candidates once their subtree is gone.
 
+Every eviction funnels through ONE path: the optional ``on_evict``
+callback fires per victim with ``(path, block)`` — ``path`` being the
+tuple of block token-keys from the root down to the victim — BEFORE
+the block is released, so a demotion hook (the host KV tier), a plain
+drop, and test instrumentation all observe the identical sequence of
+events.  The block is still allocated while the callback runs (its
+k/v rows are gatherable); a callback that raises is logged and the
+eviction proceeds — a flaky demotion target must not wedge the pool.
+
 Thread model: the serving worker is the only mutator; counters are
 lock-guarded so stats/metrics reads from other threads are consistent.
 Eviction rescans the trie per freed block — fine at serving scale
@@ -35,10 +44,13 @@ Eviction rescans the trie per freed block — fine at serving scale
 """
 from __future__ import annotations
 
+import logging
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from bigdl_tpu.serving.kvcache.blocks import BlockPool
+
+log = logging.getLogger("bigdl_tpu.serving")
 
 
 class _Node:
@@ -56,9 +68,16 @@ class _Node:
 class RadixCache:
     """Longest-prefix block reuse over a :class:`BlockPool`."""
 
-    def __init__(self, pool: BlockPool):
+    def __init__(self, pool: BlockPool,
+                 on_evict: Optional[Callable[[Tuple[Tuple[int, ...], ...],
+                                              int], None]] = None):
         self.pool = pool
         self.block_len = pool.block_len
+        #: the single eviction funnel: called as ``on_evict(path,
+        #: block)`` per victim, before release, while the block is
+        #: still allocated.  Reassignable live (the engine wires the
+        #: host-tier demotion hook here).
+        self.on_evict = on_evict
         self._lock = threading.Lock()
         self._root = _Node(None, None, None, 0)
         self._clock = 0
@@ -139,6 +158,35 @@ class RadixCache:
                 out.append(n)
         return out
 
+    @staticmethod
+    def _path_of(node: _Node) -> Tuple[Tuple[int, ...], ...]:
+        """Block token-keys from the root down to ``node`` — the
+        tier-store identity of the node's block (content-addressed by
+        its full prefix, so a demoted block is re-findable by any
+        future prompt sharing that prefix)."""
+        keys: List[Tuple[int, ...]] = []
+        while node.key is not None:
+            keys.append(node.key)
+            node = node.parent
+        return tuple(reversed(keys))
+
+    def _evict_node(self, v: _Node) -> None:
+        """THE eviction path — every drop goes through here.  Fires
+        ``on_evict`` (demotion hook / instrumentation) while the block
+        is still allocated, then releases the trie's reference."""
+        hook = self.on_evict
+        if hook is not None:
+            try:
+                hook(self._path_of(v), int(v.block))
+            except Exception:  # noqa: BLE001 — a failing demotion
+                # target degrades the eviction to a plain drop
+                log.exception("radix on_evict hook failed; dropping "
+                              "block %d", v.block)
+        del v.parent.children[v.key]
+        self.pool.release([v.block])
+        self.nodes -= 1
+        self.evictions += 1
+
     def evict(self, n_blocks: int) -> int:
         """Free up to ``n_blocks`` pool blocks by dropping LRU leaf
         nodes whose block has no holder but the trie (refcount 1).
@@ -151,11 +199,7 @@ class RadixCache:
                            if self.pool.refcount(n.block) == 1]
                 if not victims:
                     break
-                v = min(victims, key=lambda n: n.last_used)
-                del v.parent.children[v.key]
-                self.pool.release([v.block])
-                self.nodes -= 1
-                self.evictions += 1
+                self._evict_node(min(victims, key=lambda n: n.last_used))
                 freed += 1
         return freed
 

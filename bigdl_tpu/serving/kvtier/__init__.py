@@ -1,0 +1,27 @@
+"""bigdl_tpu.serving.kvtier — tiered KV memory below the HBM arena.
+
+The memory hierarchy for transformer KV state, in the Spark
+BlockManager spill lineage: the HBM :class:`BlockPool` arena on top,
+a capacity-bounded host-RAM :class:`HostBlockStore` under it, and an
+optional disk spill directory at the bottom.  Radix-tail eviction
+DEMOTES unreferenced prefix blocks down a tier instead of dropping
+them; admission PROMOTES surviving prefixes back into HBM through the
+32 MB chunked transfer discipline; and ``LMServingEngine.hibernate``
+swaps an idle stream's whole chain out of its decode slot and resumes
+it bit-exactly later.
+
+Quickstart::
+
+    from bigdl_tpu.serving import LMServingEngine
+    from bigdl_tpu.serving.kvtier import HostBlockStore
+
+    tier = HostBlockStore(host_bytes=256 << 20, spill_dir="/tmp/kv")
+    eng = LMServingEngine(model, kvtier=tier)
+    st = eng.submit(prompt)
+    ...                       # read a few tokens
+    eng.hibernate(st.stream)  # slot + HBM freed; chain in host tier
+    eng.resume(st.stream)     # bit-exact continuation
+"""
+from bigdl_tpu.serving.kvtier.store import HostBlockStore, block_path
+
+__all__ = ["HostBlockStore", "block_path"]

@@ -335,7 +335,7 @@ class _Slot:
     __slots__ = ("stream", "pos_next", "last0", "remaining", "step_idx",
                  "temperature", "eos0", "step_keys", "last_emit_at",
                  "blocks", "table", "draft_ok", "demoted", "accept_ema",
-                 "spec_rounds", "probe_in", "rid")
+                 "spec_rounds", "probe_in", "rid", "replay")
 
     def __init__(self, req: _Request, prompt_len: int, first0: int,
                  blocks: List[int], table: np.ndarray):
@@ -351,6 +351,11 @@ class _Slot:
         self.last_emit_at = time.perf_counter()
         self.blocks = blocks            # one pool ref per block
         self.table = table              # (M,) int32, scratch-padded
+        # already-emitted 0-based tokens whose KV the decode loop must
+        # rebuild (payload-less resume): forced through decode without
+        # re-emitting, so the rebuilt rows ride the exact path that
+        # wrote the originals
+        self.replay: deque = deque()
         # speculation state (spec engines only)
         self.draft_ok = False           # drafter holds this slot's KV
         self.demoted = False            # plain decode until re-probe
@@ -388,6 +393,37 @@ class KVHandoff:
         self.payload = None             # {"k","v","blocks"} wire or None
         self.matched = []               # decode-pool blocks, pre-retained
         self.src_name = src_name
+
+
+class _Hibernated:
+    """One stream swapped out of its decode slot into the host KV
+    tier — the hibernation analogue of :class:`KVHandoff`.  Carries
+    the full sampling/position state (``pos_next``, ``last0``,
+    ``remaining``, ``step_idx``, ``step_keys``) so resume re-enters
+    decode at the exact token the slot left off; the KV chain itself
+    lives in the :class:`~bigdl_tpu.serving.kvtier.HostBlockStore`
+    under ``("session", rid)`` until resume pops it.  ``payload`` is
+    populated at resume time (and kept across a pool-pressure
+    deferral, so a popped chain is never re-read or lost)."""
+
+    __slots__ = ("stream", "rid", "pos_next", "last0", "remaining",
+                 "step_idx", "temperature", "eos0", "step_keys",
+                 "n_used", "payload", "fetched", "hibernated_at")
+
+    def __init__(self, st: "_Slot", n_used: int):
+        self.stream = st.stream
+        self.rid = st.rid
+        self.pos_next = int(st.pos_next)
+        self.last0 = int(st.last0)
+        self.remaining = int(st.remaining)
+        self.step_idx = int(st.step_idx)
+        self.temperature = st.temperature
+        self.eos0 = st.eos0
+        self.step_keys = st.step_keys
+        self.n_used = int(n_used)       # exported blocks (written KV)
+        self.payload = None             # wire payload once fetched
+        self.fetched = False            # tier lookup happened
+        self.hibernated_at = time.perf_counter()
 
 
 class _Prefill:
@@ -476,6 +512,16 @@ class LMServingEngine:
             the request leaves this engine — the DisaggCoordinator
             exports the chain and hands it to a decode replica's
             :meth:`adopt`.  Mutually exclusive with ``spec``.
+        kvtier: optional
+            :class:`~bigdl_tpu.serving.kvtier.HostBlockStore` — the
+            host-RAM (+ disk spill) KV tier below the HBM arena.  When
+            set, radix-tail eviction DEMOTES unreferenced prefix
+            blocks into it instead of dropping them (int8 pools demote
+            with their scales), admission PROMOTES any surviving
+            host-tier continuation of a matched prefix back into HBM
+            (prefilling only past it), and :meth:`hibernate` /
+            :meth:`resume` swap whole idle streams out of their decode
+            slots and back, bit-exactly.
         metrics / metrics_prefix: inject a shared :class:`LMMetrics`
             (the coordinator aggregates each phase's replicas into one
             per-phase histogram set for the SLO ladders) and/or publish
@@ -504,6 +550,7 @@ class LMServingEngine:
                  spec=None,
                  max_prefill_chunk_tokens: Optional[int] = None,
                  migrate=None,
+                 kvtier=None,
                  metrics: Optional[LMMetrics] = None,
                  metrics_prefix: str = "serving/lm/"):
         select_platform(platform)
@@ -572,8 +619,10 @@ class LMServingEngine:
                 "replicas")
         if kv_quant is not None and migrate is not None:
             raise ValueError(
-                "kv_quant='int8' excludes disaggregated serving: "
-                "quantized pools do not support chain export/adopt")
+                "kv_quant='int8' excludes disaggregated serving: the "
+                "handoff protocol carries full-precision wire payloads "
+                "(the host KV tier, not the coordinator, is the "
+                "quantized-chain migration path)")
         self.max_prefill_chunk_tokens = None
         self._chunk_cap = None
         if max_prefill_chunk_tokens is not None:
@@ -613,6 +662,12 @@ class LMServingEngine:
                 self.pool.ks = jax.device_put(self.pool.ks, _rep)
                 self.pool.vs = jax.device_put(self.pool.vs, _rep)
         self.radix = RadixCache(self.pool) if enable_prefix_cache else None
+        self.kvtier = kvtier
+        if self.kvtier is not None and self.radix is not None:
+            # THE demote hook: radix-tail eviction hands each victim
+            # block to the host tier (with scales, when quantized)
+            # instead of dropping it
+            self.radix.on_evict = self._demote_block
         self._cache_dtype = dt
         # prefix-chain pad buckets (powers of two up to the table width)
         self._prefix_block_buckets = prefill_bucket_lengths(
@@ -785,6 +840,13 @@ class LMServingEngine:
         self.migrated = 0       # prefill phase: chains handed off
         self.adopted = 0        # decode phase: chains seated
         self.re_prefills = 0    # decode phase: lost payloads recomputed
+        # -- session hibernation (host KV tier) ------------------------- #
+        self._hibernate_req: set = set()     # rids awaiting swap-out
+        self._hibernated: dict = {}          # rid -> _Hibernated
+        self._resume_q: deque = deque()      # _Hibernated awaiting seats
+        self.hibernations = 0   # streams swapped out to the host tier
+        self.resumes = 0        # streams seated back from hibernation
+        self.resume_re_prefills = 0  # lost payloads rebuilt via replay
         # the SLO controller's decode-concurrency actuator: the decode
         # executable always steps the full S physical slots (fixed
         # shape — no recompile), but admission only fills slots up to
@@ -1154,15 +1216,20 @@ class LMServingEngine:
             while True:
                 with self._cv:
                     while (not self._queue and not self._adopt_q
+                           and not self._resume_q
                            and not self._n_active and not self._prefilling
                            and not self._closing and not self._abort):
                         self._cv.wait()
                     if self._abort:
                         break
                     if (self._closing and not self._queue
-                            and not self._adopt_q and not self._n_active
+                            and not self._adopt_q and not self._resume_q
+                            and not self._n_active
                             and not self._prefilling):
-                        return
+                        # break (not return): the bottom _fail_all
+                        # resolves any still-hibernated streams with
+                        # ServingClosed instead of leaving them hanging
+                        break
                     # in-flight = decoding + mid-prefill: both hold slots
                     inflight = self._n_active + len(self._prefilling)
                     adopts = []
@@ -1171,10 +1238,19 @@ class LMServingEngine:
                            and (inflight + len(adopts)) < self._slot_limit):
                         adopts.append((self._free.pop(),
                                        self._adopt_q.popleft()))
+                    # resumes rank with adoptions (same reason) but
+                    # after them: a migrated chain in transit is hotter
+                    # than a hibernated one at rest
+                    resumes = []
+                    while (self._free and self._resume_q
+                           and (inflight + len(adopts) + len(resumes))
+                           < self._slot_limit):
+                        resumes.append((self._free.pop(),
+                                        self._resume_q.popleft()))
                     admits = []
                     while (self._free and self._queue
-                           and (inflight + len(adopts) + len(admits))
-                           < self._slot_limit):
+                           and (inflight + len(adopts) + len(resumes)
+                                + len(admits)) < self._slot_limit):
                         admits.append((self._free.pop(),
                                        self._queue.popleft()))
                 if self.migrate is not None:
@@ -1196,6 +1272,17 @@ class LMServingEngine:
                     else:
                         if not seated:
                             deferred_adopts.append((slot, h))
+                deferred_resumes = []
+                for slot, hib in resumes:
+                    try:
+                        seated = self._resume_into(slot, hib)
+                    except BaseException as e:  # noqa: BLE001
+                        hib.stream._finish(error=e)
+                        with self._cv:
+                            self._free.append(slot)
+                    else:
+                        if not seated:
+                            deferred_resumes.append((slot, hib))
                 deferred = []
                 for slot, req in admits:
                     try:
@@ -1207,7 +1294,7 @@ class LMServingEngine:
                     else:
                         if not admitted:
                             deferred.append((slot, req))
-                if deferred or deferred_adopts:
+                if deferred or deferred_adopts or deferred_resumes:
                     # pool pressure: requeue at the FRONT (FIFO order
                     # preserved) and return the slots — blocks free as
                     # active streams finish, then admission retries
@@ -1218,6 +1305,11 @@ class LMServingEngine:
                         for slot, h in reversed(deferred_adopts):
                             self._free.append(slot)
                             self._adopt_q.appendleft(h)
+                        for slot, hib in reversed(deferred_resumes):
+                            self._free.append(slot)
+                            self._resume_q.appendleft(hib)
+                if self._hibernate_req:
+                    self._service_hibernations()
                 if self._chunk_cap is not None and self._prefilling:
                     # Sarathi interleave: ONE bounded chunk of the
                     # oldest in-progress prefill per scheduler round,
@@ -1254,6 +1346,12 @@ class LMServingEngine:
         matched: List[int] = []
         if self.radix is not None:
             matched = self.radix.match(req.prompt0)  # retains for us
+            if self.kvtier is not None:
+                # a prefix that fell out of HBM may have survived a
+                # tier down: promote its continuation back and extend
+                # the match (prefill only past it)
+                matched = self._promote_extend(req.prompt0, matched,
+                                               rid=req.rid)
         traced = _tracer.sampled(req.rid)
         if traced and self.radix is not None:
             _tracer.instant("lm/radix_match", cat="serve",
@@ -1377,6 +1475,300 @@ class LMServingEngine:
                             matched_blocks=len(matched), src=h.src_name)
         self._seat(req, t, h.first0, blocks, slot)
         return True
+
+    # -- tiered KV memory (host tier + hibernation) --------------------- #
+    def _demote_block(self, path, block: int) -> None:
+        """Radix ``on_evict`` hook: gather the victim block's k/v rows
+        (plus scales, when quantized — atomically, same payload) and
+        demote them into the host tier keyed by the block's
+        token-prefix path.  Runs while the block is still allocated."""
+        wire = self.pool.export_chain([block])
+        entry = {kk: wire[kk] for kk in ("k", "v", "ks", "vs")
+                 if kk in wire}
+        self.kvtier.put(("radix",) + tuple(path), entry)
+        _tracer.instant("kvtier/demote", cat="serve", block=int(block),
+                        depth=len(path))
+
+    def _promote_extend(self, prompt0, matched: List[int], *,
+                        rid=None) -> List[int]:
+        """Extend a radix-matched head with consecutive host-tier
+        blocks: each surviving continuation block is adopted back into
+        HBM (over the 32 MB chunked transfer), registered in the trie,
+        and appended to the match — the admission then prefills only
+        past the combined prefix.  Best-effort: pool pressure or a
+        tier miss just returns the match as-is."""
+        t = prompt0.shape[0]
+        B = self.block_len
+        cap = max(0, (t - 1) // B)
+        m = len(matched)
+        if m >= cap or self.radix is None:
+            return matched
+        from bigdl_tpu.serving.kvtier.store import block_path
+        keys = block_path(prompt0, B, cap)
+        payloads = []
+        for i in range(m, cap):
+            p = self.kvtier.get(("radix",) + keys[:i + 1])
+            if p is None:
+                break
+            payloads.append(p)
+        if not payloads:
+            return matched
+        quant = self.kv_quant is not None
+        L, _, H, Bl, D = self.pool.shape
+        if (payloads[0]["k"].shape[1:] != (L, H, Bl, D)
+                or (quant and "ks" not in payloads[0])):
+            # stale entries from a different geometry/precision under
+            # the same store name: not promotable into this pool
+            return matched
+        k = np.concatenate([p["k"] for p in payloads], axis=0)
+        v = np.concatenate([p["v"] for p in payloads], axis=0)
+        ks = (np.concatenate([p["ks"] for p in payloads], axis=0)
+              if quant else None)
+        vs = (np.concatenate([p["vs"] for p in payloads], axis=0)
+              if quant else None)
+        nbytes = k.nbytes + v.nbytes
+        if quant:
+            nbytes += ks.nbytes + vs.nbytes
+        rid_args = {"request_id": rid} if _tracer.sampled(rid) else {}
+        t0 = time.perf_counter()
+        with _tracer.span("kvtier/promote", cat="serve",
+                          blocks=len(payloads), bytes=int(nbytes),
+                          **rid_args):
+            try:
+                fresh = self.pool.adopt_chain(
+                    k, v, ks, vs, extra_blocks=0,
+                    device=self.pool.k.sharding)
+            except PoolExhausted:
+                # promotion is opportunistic — never deepen the very
+                # pressure it is trying to relieve
+                return matched
+        self.kvtier.record_promote(nbytes, time.perf_counter() - t0)
+        n_total = m + len(fresh)
+        out = list(matched) + fresh
+        # trie registration: future admissions share the promoted
+        # blocks straight from HBM, and the trie's reference keeps
+        # them demotable again once every stream lets go
+        self.radix.insert(prompt0[:n_total * B], out)
+        with self.radix._lock:
+            # promoted blocks save suffix prefill exactly like a trie
+            # hit — fold them into the same saved-tokens ledger
+            self.radix.matched_tokens += len(fresh) * B
+        return out
+
+    def hibernate(self, stream: LMStream, *,
+                  timeout: Optional[float] = 30.0) -> bool:
+        """Swap an idle stream out of its decode slot: its written KV
+        chain moves to the host tier (``("session", rid)``), its slot
+        and every HBM block free, and its full sampling state is kept
+        so :meth:`resume` continues the stream bit-exactly on the next
+        token.  Blocks until the worker performs the swap (it owns the
+        slots).  Returns True once hibernated; False when the stream
+        is not currently seated in a decode slot (queued, mid-prefill,
+        mid-replay, or already finished)."""
+        if self.kvtier is None:
+            raise ValueError(
+                "hibernate requires a kvtier (HostBlockStore)")
+        rid = stream.request_id
+        with self._cv:
+            if rid in self._hibernated:
+                return True
+            seated = any(st is not None and st.rid == rid
+                         and not st.replay for st in self._slots)
+            if not seated or stream.done():
+                return False
+            self._hibernate_req.add(rid)
+            self._cv.notify_all()
+            self._cv.wait_for(lambda: rid not in self._hibernate_req,
+                              timeout)
+            self._hibernate_req.discard(rid)
+            return rid in self._hibernated
+
+    def resume(self, stream: LMStream) -> bool:
+        """Re-admit a hibernated stream: its chain promotes back into
+        HBM through the chunked transfer (or, if the tier dropped the
+        payload, the prompt re-prefills and the generated tokens
+        replay through the decode path — bit-exact either way) and
+        decode continues at the exact token it left off.  Resumes
+        rank with adoptions, ahead of fresh admissions.  Returns False
+        when the stream is not hibernated."""
+        rid = stream.request_id
+        with self._cv:
+            if self._closing:
+                raise ServingClosed("LMServingEngine is closed")
+            hib = self._hibernated.pop(rid, None)
+            if hib is None:
+                return False
+            self._resume_q.append(hib)
+            self._cv.notify_all()
+        if _tracer.sampled(rid):
+            _tracer.instant("lm/resume_enqueue", cat="serve",
+                            request_id=rid,
+                            hibernated_s=round(
+                                time.perf_counter() - hib.hibernated_at,
+                                4))
+        return True
+
+    def _service_hibernations(self) -> None:
+        """Worker-side swap-out: export each requested seated slot's
+        written blocks to the host tier, release the chain, free the
+        slot.  Requests for streams no longer seated are discarded so
+        their waiters unblock."""
+        with self._cv:
+            todo = [(i, st) for i, st in enumerate(self._slots)
+                    if st is not None and st.rid in self._hibernate_req
+                    and not st.replay]
+            stale = self._hibernate_req - {st.rid for _, st in todo}
+            if stale:
+                self._hibernate_req -= stale
+                self._cv.notify_all()
+        for i, st in todo:
+            self._hibernate_one(i, st)
+
+    def _hibernate_one(self, slot: int, st: _Slot) -> None:
+        n_used = self.pool.blocks_for(st.pos_next)
+        rid_args = ({"request_id": st.rid}
+                    if _tracer.sampled(st.rid) else {})
+        with _tracer.span("kvtier/hibernate", cat="serve", slot=slot,
+                          blocks=n_used, **rid_args):
+            wire = self.pool.export_chain(st.blocks[:n_used])
+            entry = {kk: wire[kk] for kk in ("k", "v", "ks", "vs")
+                     if kk in wire}
+            self.kvtier.put(("session", st.rid), entry)
+        hib = _Hibernated(st, n_used)
+        self.pool.release(st.blocks)
+        if self.draft is not None:
+            # the drafter's dense per-slot cache does not hibernate;
+            # the resumed stream rides plain decode (still bit-exact)
+            self.draft.release(slot)
+        with self._cv:
+            self._slots[slot] = None
+            self._free.append(slot)
+            self._n_active -= 1
+            self._hibernate_req.discard(st.rid)
+            self._hibernated[st.rid] = hib
+            self.hibernations += 1
+            self._cv.notify_all()
+
+    def _resume_into(self, slot: int, hib: _Hibernated) -> bool:
+        """Seat a hibernated stream back into ``slot``.  Returns False
+        (defer) under pool pressure — a popped payload stays cached on
+        the handle across deferrals, never re-read or lost."""
+        stream = hib.stream
+        t = int(stream.prompt.shape[0])
+        prompt0 = (stream.prompt.astype(np.int32) - 1)
+        max_new = int(stream.max_new)
+        need_total = self.pool.blocks_for(t + max_new)
+        B = self.block_len
+        rid_args = ({"request_id": hib.rid}
+                    if _tracer.sampled(hib.rid) else {})
+        req = _Request(stream, prompt0, max_new, hib.temperature,
+                       hib.eos0, None, hib.step_keys, hib.rid)
+        if not hib.fetched:
+            hib.payload = self.kvtier.get(("session", hib.rid), pop=True)
+            hib.fetched = True
+        if hib.payload is not None:
+            payload = hib.payload
+            n_wire = int(payload["k"].shape[0])
+            extra = need_total - n_wire
+            nbytes = sum(int(payload[x].nbytes) for x in payload)
+            t0 = time.perf_counter()
+            with _tracer.span("kvtier/promote", cat="serve",
+                              blocks=n_wire, bytes=int(nbytes),
+                              session=1, **rid_args):
+                try:
+                    fresh = self.pool.adopt_chain(
+                        payload["k"], payload["v"],
+                        payload.get("ks"), payload.get("vs"),
+                        extra_blocks=extra,
+                        device=self.pool.k.sharding)
+                except PoolExhausted:
+                    if self.radix is not None:
+                        self.radix.evict(n_wire + extra
+                                         - self.pool.free_count)
+                    try:
+                        fresh = self.pool.adopt_chain(
+                            payload["k"], payload["v"],
+                            payload.get("ks"), payload.get("vs"),
+                            extra_blocks=extra,
+                            device=self.pool.k.sharding)
+                    except PoolExhausted:
+                        return False
+            self.kvtier.record_promote(nbytes, time.perf_counter() - t0)
+            blocks = fresh
+            if self.radix is not None:
+                nfull = t // B
+                if nfull:
+                    self.radix.insert(prompt0[:nfull * B],
+                                      blocks[:nfull])
+            self._seat_resumed(req, hib, blocks, slot,
+                               pos_next=hib.pos_next, last0=hib.last0,
+                               remaining=hib.remaining,
+                               step_idx=hib.step_idx, replay=())
+            _tracer.instant("lm/resume", cat="serve", slot=slot,
+                            wire_blocks=n_wire, **rid_args)
+            return True
+        # payload lost (capacity-dropped or corrupt spill): rebuild.
+        # Prompt KV recomputes through the same deterministic prefill
+        # admission ran; the generated tokens' KV rebuilds by REPLAYING
+        # them through the decode path that wrote the originals — both
+        # legs bit-identical, no token is ever re-emitted.
+        emitted0 = np.asarray(stream.generated, np.int32) - 1
+        matched: List[int] = []
+        if self.radix is not None:
+            matched = self.radix.match(prompt0)
+            matched = self._promote_extend(prompt0, matched,
+                                           rid=hib.rid)
+        n_new = need_total - len(matched)
+        try:
+            fresh = self.pool.alloc(n_new)
+        except PoolExhausted:
+            if self.radix is not None:
+                self.radix.evict(n_new - self.pool.free_count)
+            try:
+                fresh = self.pool.alloc(n_new)
+            except PoolExhausted:
+                if matched:
+                    self.pool.release(matched)
+                return False
+        blocks = matched + fresh
+        self.resume_re_prefills += 1
+        pf = _Prefill(req, blocks, slot, len(matched) * B)
+        try:
+            while not self._prefill_chunk(pf):
+                pass
+        except BaseException:
+            self.pool.release(blocks)
+            raise
+        if self.radix is not None:
+            nfull = t // B
+            if nfull:
+                self.radix.insert(prompt0[:nfull * B], blocks[:nfull])
+        self._seat_resumed(req, hib, blocks, slot, pos_next=t,
+                           last0=int(emitted0[0]),
+                           remaining=max_new - 1, step_idx=0,
+                           replay=tuple(int(x) for x in emitted0[1:]))
+        _tracer.instant("lm/resume", cat="serve", slot=slot,
+                        re_prefill=1, replay=len(emitted0) - 1,
+                        **rid_args)
+        return True
+
+    def _seat_resumed(self, req: _Request, hib: _Hibernated,
+                      blocks: List[int], slot: int, *, pos_next: int,
+                      last0: int, remaining: int, step_idx: int,
+                      replay) -> None:
+        table = np.zeros((self.table_width,), np.int32)
+        table[:len(blocks)] = blocks
+        st = _Slot(req, pos_next, last0, blocks, table)
+        st.remaining = int(remaining)
+        st.step_idx = int(step_idx)
+        st.replay = deque(replay)
+        # resumed streams ride plain decode (draft_ok stays False) and
+        # interrupt the ITL stream the way an adoption does
+        self._prefill_since_step = True
+        with self._cv:
+            self._slots[slot] = st
+            self._n_active += 1
+            self.resumes += 1
 
     @staticmethod
     def _trace_done(stream: LMStream, rid: Optional[str]) -> None:
@@ -1579,6 +1971,18 @@ class LMServingEngine:
         for i, st in enumerate(self._slots):
             if st is None:
                 continue
+            if st.replay:
+                # payload-less resume: this step just rebuilt last0's
+                # KV row; the next token was already emitted before
+                # hibernation — take it from the replay queue instead
+                # of the logits (no re-emit, no ITL sample).  The
+                # queue preserves the original step_keys alignment, so
+                # post-replay sampling is bit-exact.
+                st.last0 = st.replay.popleft()
+                st.pos_next += 1
+                st.step_idx += 1
+                st.remaining -= 1
+                continue
             nxt0 = self._pick(
                 logits[i], st.temperature,
                 st.step_keys[st.step_idx]
@@ -1721,6 +2125,16 @@ class LMServingEngine:
         n_emitted = 0
         for i in active:
             st = self._slots[i]
+            if st.replay:
+                # payload-less resume riding a spec round as a plain
+                # n_cand=1 row: the verify kernel rebuilt last0's KV;
+                # the next token replays instead of sampling (resumed
+                # slots have draft_ok=False, so no draft state exists)
+                st.last0 = st.replay.popleft()
+                st.pos_next += 1
+                st.step_idx += 1
+                st.remaining -= 1
+                continue
             ds, qrows = drafts.get(i, ((), None))
             k_eff = len(ds)
             emitted = []
@@ -1817,6 +2231,15 @@ class LMServingEngine:
             self._n_active = 0
             if self.draft is not None:
                 self.draft.release_all()
+            # hibernated / resuming streams hold no pool blocks (their
+            # chains live in the host tier), but their clients are
+            # still waiting — resolve them too
+            pending.extend(h.stream for h in self._hibernated.values())
+            self._hibernated.clear()
+            pending.extend(h.stream for h in self._resume_q)
+            self._resume_q.clear()
+            self._hibernate_req.clear()
+            self._cv.notify_all()
         for s in pending:
             s._finish(error=error)
 
@@ -1844,6 +2267,7 @@ class LMServingEngine:
             max_queue = self._max_queue
             prefilling = len(self._prefilling)
             adopt_q = len(self._adopt_q)
+            hibernated = len(self._hibernated)
         return {
             "name": self.name,
             "slots": self.slots,
@@ -1867,6 +2291,12 @@ class LMServingEngine:
             "prefill_cache": self.prefill_cache.stats(),
             "prefix_prefill_cache": self.prefix_prefill_cache.stats(),
             "kvcache": self.kvcache_stats(),
+            "kvtier": (self.kvtier.stats()
+                       if self.kvtier is not None else None),
+            "hibernated": hibernated,
+            "hibernations": self.hibernations,
+            "resumes": self.resumes,
+            "resume_re_prefills": self.resume_re_prefills,
             "metrics": self.metrics.snapshot(),
             "spec": self._spec_stats(),
         }

@@ -61,6 +61,7 @@ if [ "$SMOKE" = "1" ]; then
   SERVE_LM_ARGS="--requests 6 --slots 2 --cache-len 64 --mean-gap-ms 5 --probes 1"
   SPEC_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1"
   QCOMPUTE_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1 --duel-iters 2"
+  KVTIER_ARGS="--probes 2 --slots 2 --cache-len 64 --block-len 8 --sessions 6 --rounds 2 --timing-samples 3"
   PREFIX_ARGS="--requests 6 --slots 2 --cache-len 96 --shared-len 32 --mean-gap-ms 5 --probes 1"
   DISAGG_ARGS="--requests 8 --slots 4 --cache-len 128 --chunk-tokens 16 --mean-gap-ms 5 --probes 1"
   SLO_ARGS="--loads 4,8 --duration 1.5 --chaos-duration 2 --chaos-rps 15 --slots 2 --cache-len 64"
@@ -84,6 +85,7 @@ else
   SERVE_LM_ARGS="--requests 48 --slots 8 --cache-len 128"
   SPEC_ARGS="--requests 24 --slots 8 --cache-len 128"
   QCOMPUTE_ARGS="--requests 24 --slots 8 --cache-len 128"
+  KVTIER_ARGS=""
   PREFIX_ARGS="--requests 24 --slots 8 --cache-len 128 --shared-len 64"
   DISAGG_ARGS="--requests 24 --slots 8 --cache-len 128 --chunk-tokens 32"
   SLO_ARGS="--loads 4,8,16,32,64 --duration 5 --chaos-duration 8"
@@ -124,6 +126,7 @@ ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json TUNE_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
 BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json BENCH_MESH.json \
 BENCH_SPEC.json BENCH_DISAGG.json BENCH_QCOMPUTE.json \
+BENCH_KVTIER.json \
 FLIGHT_*.json TRACE_*.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
@@ -335,6 +338,29 @@ qcompute_stage() {
   return 1
 }
 
+# kvtier rides right after qcompute: host-tier KV offload + session
+# hibernation.  On a real chip the promote path exercises the actual
+# host->HBM transfer (32 MB chunk discipline) so promote_mbs becomes
+# relay evidence, and the hibernate/resume agreement gate proves the
+# roundtrip is bit-exact through the real device, not just CPU.  Same
+# ok_lm gate (the committed CPU BENCH_KVTIER.json must never mark the
+# TPU stage done) and the same never-gates-the-round contract.  Chain
+# exports are < 2 MB per session at these shapes, far below the 32 MB
+# relay ceiling.
+kvtier_stage() {
+  ok_lm BENCH_KVTIER.json && return 0
+  say "stage kvtier: firing (budget 600s): python -u bench.py --serve-lm --kvtier $KVTIER_ARGS"
+  timeout 600 python -u bench.py --serve-lm --kvtier $KVTIER_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm BENCH_KVTIER.json; then
+    say "stage kvtier: DONE"
+    return 0
+  fi
+  say "stage kvtier: not done (rc=$rc)"
+  record_incident kvtier "$rc"
+  return 1
+}
+
 # mesh rides right after serve-lm: it proves the placement subsystem
 # against the REAL device set (TP-slot carving + sharded param staging
 # through the chunked relay discipline) — on a multi-chip window the
@@ -486,6 +512,7 @@ while :; do
     serve_lm_stage
     spec_stage
     qcompute_stage
+    kvtier_stage
     mesh_stage
     prefix_stage
     disagg_stage

@@ -242,6 +242,50 @@ def _qcompute_problems(doc) -> list:
     return probs
 
 
+def _kvtier_problems(doc) -> list:
+    """BENCH_KVTIER.json extras: a memory tier must be invisible to
+    the sampler — the hibernate_exact stage's agreement must be
+    exactly 1.0 in a complete artifact (a resumed stream that diverges
+    by one token is corruption, not a miss).  A complete doc must also
+    show the tier actually working: a nonzero oversubscribed-stage
+    prefix hit rate and a TTFT-on-resume that beat the engine's own
+    re-prefill + replay fallback."""
+    probs = []
+    if doc.get("error"):
+        return probs
+    rows = {r.get("stage"): r for r in doc.get("rows", [])
+            if isinstance(r, dict)}
+    for i, r in enumerate(doc.get("rows", [])):
+        if isinstance(r, dict) and "stage" not in r:
+            probs.append("kvtier row %d lacks a 'stage' key" % i)
+    if doc.get("complete") is not True:
+        return probs
+    hib = rows.get("hibernate_exact")
+    if not isinstance(hib, dict) or hib.get("agreement") != 1.0:
+        probs.append("complete kvtier artifact: hibernate_exact "
+                     "agreement must be exactly 1.0, got %r"
+                     % ((hib or {}).get("agreement"),))
+    over = rows.get("oversubscribed")
+    if not isinstance(over, dict) or not over.get("prefix_hit_rate"):
+        probs.append("complete kvtier artifact: oversubscribed "
+                     "prefix_hit_rate must be nonzero, got %r"
+                     % ((over or {}).get("prefix_hit_rate"),))
+    summ = doc.get("summary")
+    if not isinstance(summ, dict):
+        probs.append("complete kvtier artifact lacks a summary")
+        return probs
+    if summ.get("agreement") != 1.0:
+        probs.append("complete kvtier artifact: summary.agreement "
+                     "must be exactly 1.0, got %r"
+                     % (summ.get("agreement"),))
+    for key in ("ttft_resume_ms", "ttft_reprefill_ms",
+                "prefix_hit_rate"):
+        if not isinstance(summ.get(key), (int, float)):
+            probs.append("complete kvtier artifact: summary.%s must "
+                         "be numeric, got %r" % (key, summ.get(key)))
+    return probs
+
+
 def _problems(doc, name: str = "") -> list:
     """Contract violations for one parsed artifact document."""
     probs = []
@@ -277,6 +321,8 @@ def _problems(doc, name: str = "") -> list:
             probs.extend(_disagg_problems(doc))
         if name == "BENCH_QCOMPUTE.json":
             probs.extend(_qcompute_problems(doc))
+        if name == "BENCH_KVTIER.json":
+            probs.extend(_kvtier_problems(doc))
         return probs
     if "metric" not in doc:
         probs.append("no 'rows', no supervisor record, no 'metric' key "

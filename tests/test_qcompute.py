@@ -278,11 +278,13 @@ def test_blockpool_int8_arenas_and_migration_gate():
                       num_blocks=6, dtype=np.float32)
     assert pool.arena_bytes < plain.arena_bytes
     assert plain.stats()["kv_quant"] == "none"
-    with pytest.raises(NotImplementedError):
-        pool.export_chain([1])
-    with pytest.raises(NotImplementedError):
-        pool.adopt_chain(np.zeros((1, 1, 2, 4, 8), np.float32),
-                         np.zeros((1, 1, 2, 4, 8), np.float32))
+    # int8 chains DO export/adopt (PR 16 host-tier demotion rides
+    # this), but the scales travel atomically: a wire payload without
+    # them cannot dequantize and must be refused
+    wire = pool.export_chain([1])
+    assert wire["k"].dtype == np.int8 and "ks" in wire and "vs" in wire
+    with pytest.raises(ValueError):
+        pool.adopt_chain(wire["k"], wire["v"])
     with pytest.raises(ValueError):
         BlockPool(n_layers=1, n_heads=2, head_dim=8, block_len=4,
                   num_blocks=6, kv_quant="int4")
