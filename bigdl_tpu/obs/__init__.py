@@ -1,6 +1,6 @@
 """bigdl_tpu.obs — unified observability: tracing, telemetry, forensics.
 
-Five pieces, one spine:
+Six pieces, one spine:
 
 - :mod:`~bigdl_tpu.obs.tracer` — thread-safe span API (context manager
   + decorator) over a ring buffer, exported as Chrome trace-event JSON
@@ -31,6 +31,12 @@ Five pieces, one spine:
 - :mod:`~bigdl_tpu.obs.watchdog` — StallWatchdog: rolling-median step
   cadence; a hung step captures ``Engine.diagnose_tpu()`` + all-thread
   stacks into the trace before the process looks merely "slow".
+- :mod:`~bigdl_tpu.obs.ledger` — MemoryLedger: process-wide HBM byte
+  attribution (params / KV arenas / drafter / kvtier / executables),
+  per-executable roofline costs captured at AOT-lower time,
+  ``headroom(device)`` + reconciliation drift vs
+  ``device.memory_stats()``, and a ``mem_pressure`` flight trigger at
+  the ``BIGDL_TPU_MEM_WATERMARK`` used-fraction watermark.
 
 Quickstart::
 
@@ -48,6 +54,7 @@ Quickstart::
 """
 from bigdl_tpu.obs.flight import (FlightRecorder, get_flight_recorder,
                                   note_shed)
+from bigdl_tpu.obs.ledger import MemoryLedger, get_ledger, set_ledger
 from bigdl_tpu.obs.registry import (Counter, FnGauge, Gauge, Histogram,
                                     MetricRegistry, get_registry,
                                     percentile_from_counts)
@@ -68,6 +75,7 @@ __all__ = [
     "get_registry", "percentile_from_counts",
     "TimeSeriesSampler", "get_sampler", "set_sampler",
     "FlightRecorder", "get_flight_recorder", "note_shed",
+    "MemoryLedger", "get_ledger", "set_ledger",
     "StallWatchdog", "env_watchdog_enabled", "env_watchdog_kwargs",
     "shared_watchdog", "thread_stacks",
 ]

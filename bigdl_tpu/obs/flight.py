@@ -1,7 +1,8 @@
 """Incident flight recorder: one correlated bundle per incident.
 
 When something goes wrong in the serving stack — a watchdog stall, a
-classified backend-lost, a fault-injector fire, a shed burst — the
+classified backend-lost, a fault-injector fire, a shed burst, the
+memory ledger crossing its OOM watermark (``mem_pressure``) — the
 evidence today is scattered: a log line here, a counter there, a trace
 ring that will be overwritten in minutes.  The flight recorder freezes
 all of it at the moment of the incident into one atomically-written
@@ -22,8 +23,10 @@ all of it at the moment of the incident into one atomically-written
   cross-reference each other.
 
 Recording is OFF by default (``BIGDL_TPU_FLIGHT=1`` or
-``configure(enabled=True)`` arms it) so test suites and ad-hoc runs do
-not litter the repo root; ``BIGDL_TPU_FLIGHT_DIR`` moves the output.
+``configure(enabled=True)`` arms it); bundles land under ``flight/``
+(``BIGDL_TPU_FLIGHT_DIR`` moves them) and rotate at dump time — the
+oldest past ``BIGDL_TPU_FLIGHT_MAX`` (default 64) are pruned, so an
+incident-heavy soak can never grow the directory without bound.
 "Exactly one bundle per distinct incident": bundles dedup on
 ``(kind, key)`` within ``dedup_window_s`` — a shed burst or a
 fault-matrix sweep collapses to its first bundle per site instead of a
@@ -69,7 +72,7 @@ class FlightRecorder:
     #: incident kinds the serving stack wires up (detail carries the
     #: specifics); ad-hoc kinds are allowed — the schema only pins shape
     KINDS = ("stall", "backend_lost", "fault_injected", "shed_burst",
-             "probe_death", "stage_death")
+             "probe_death", "stage_death", "mem_pressure")
 
     def __init__(self, *, enabled: Optional[bool] = None,
                  out_dir: Optional[str] = None,
@@ -77,11 +80,24 @@ class FlightRecorder:
                  max_spans: int = 512,
                  dedup_window_s: float = 30.0,
                  shed_burst_threshold: int = 32,
-                 shed_burst_window_s: float = 5.0):
+                 shed_burst_window_s: float = 5.0,
+                 max_bundles: Optional[int] = None):
         self.enabled = _env_enabled() if enabled is None else bool(enabled)
+        # new bundles land under flight/ (not the repo root — dozens of
+        # stale FLIGHT_*.json at top level was the round-16 mess);
+        # incident-ledger pointers carry the subdir
         self.out_dir = (out_dir
                         or os.environ.get("BIGDL_TPU_FLIGHT_DIR")
-                        or os.getcwd())
+                        or os.path.join(os.getcwd(), "flight"))
+        if max_bundles is None:
+            try:
+                max_bundles = int(os.environ.get(
+                    "BIGDL_TPU_FLIGHT_MAX", "64"))
+            except ValueError:
+                max_bundles = 64
+        #: rotation bound: at dump time the oldest FLIGHT_*.json past
+        #: this count are pruned from out_dir (<= 0 disables)
+        self.max_bundles = int(max_bundles)
         #: None -> traffic.incidents.DEFAULT_PATH, resolved at dump time
         self.incidents_path = incidents_path
         self.max_spans = int(max_spans)
@@ -199,6 +215,7 @@ class FlightRecorder:
             "complete": True,
         }
         stamp = time.strftime("%Y%m%d_%H%M%S", time.localtime(now))
+        os.makedirs(self.out_dir, exist_ok=True)
         path = os.path.join(self.out_dir,
                             f"FLIGHT_{stamp}_{os.getpid()}_{seq}.json")
         from bigdl_tpu.utils.artifacts import write_artifact
@@ -206,9 +223,26 @@ class FlightRecorder:
         with self._lock:
             self.bundles_written += 1
             self.last_bundle_path = path
+        self._rotate()
         self._append_incident_pointer(kind, detail, path)
         log.warning("flight recorder: %s -> %s", kind, path)
         return path
+
+    def _rotate(self) -> None:
+        """Prune the oldest bundles past ``max_bundles``
+        (``BIGDL_TPU_FLIGHT_MAX``) — the stamp-named files sort
+        chronologically, so name order IS age order."""
+        if self.max_bundles <= 0:
+            return
+        try:
+            names = sorted(n for n in os.listdir(self.out_dir)
+                           if n.startswith("FLIGHT_")
+                           and n.endswith(".json"))
+            for name in names[:-self.max_bundles]:
+                os.remove(os.path.join(self.out_dir, name))
+        except OSError:
+            log.exception("flight bundle rotation failed in %s",
+                          self.out_dir)
 
     def _append_incident_pointer(self, kind: str, detail: dict,
                                  path: str) -> None:
@@ -225,10 +259,18 @@ class FlightRecorder:
                     rc = int(detail.get("rc", 0))
                 except (TypeError, ValueError):
                     rc = 0
+            try:
+                # pointer keeps the flight/ prefix so the ledger row
+                # resolves from the repo root
+                pointer = os.path.relpath(path, os.getcwd())
+                if pointer.startswith(".."):
+                    pointer = path
+            except ValueError:
+                pointer = os.path.basename(path)
             incidents.append_incident(
                 stage=stage, rc=rc,
                 path=self.incidents_path or incidents.DEFAULT_PATH,
-                flight=os.path.basename(path))
+                flight=pointer)
         except Exception:
             log.exception("flight recorder: incident pointer append "
                           "failed for %s", path)

@@ -64,11 +64,18 @@ class CompileCache:
     """
 
     def __init__(self, fn: Callable, *, max_entries: int = 16,
-                 donate_x: bool = False, placement_tag: str = ""):
+                 donate_x: bool = False, placement_tag: str = "",
+                 name: str = ""):
         import jax
 
         self._donate = ("x",) if donate_x else ()
         self._placement_tag = placement_tag
+        # the memory-ledger namespace for this cache's executable
+        # cost/memory rows (obs/xcost/*); defaults to the wrapped
+        # function's name, qualified by the placement slot
+        base = name or getattr(fn, "__name__", "fn").lstrip("_")
+        self.ledger_tag = (f"{base}@{placement_tag}" if placement_tag
+                           else base)
         # donating x lets XLA reuse the input buffer for activations;
         # params/buffers are never donated (reused every call)
         self._jit = jax.jit(fn, donate_argnums=(2,) if donate_x else ())
@@ -87,12 +94,30 @@ class CompileCache:
                 self._placement_tag)
 
     def _compile(self, params, buffers, x) -> Callable:
-        return self._jit.lower(params, buffers, x).compile()
+        compiled = self._jit.lower(params, buffers, x).compile()
+        # file the executable's memory_analysis()/cost_analysis() with
+        # the memory ledger at AOT-lower time — the roofline estimate
+        # is free here and unobtainable later
+        try:
+            from bigdl_tpu.obs.ledger import get_ledger
+            get_ledger().record_compiled(
+                self.ledger_tag, self._ledger_key(self.key_for(x, params)),
+                compiled)
+        except Exception:
+            pass
+        return compiled
+
+    @staticmethod
+    def _ledger_key(key: Key) -> str:
+        # input signature + quant tag; donate flags and placement are
+        # constant per cache (the placement rides the ledger tag)
+        return f"{key[0]}|{key[2]}"
 
     def _admit(self, key: Key, entry: Callable, *, count: bool) -> bool:
         """Insert a freshly compiled entry under the LRU bound; returns
         whether it was new.  ``count`` toggles the miss counter (warmup
         provisioning is not traffic)."""
+        evicted = []
         with self._lock:
             if count:
                 self.misses += 1
@@ -101,9 +126,19 @@ class CompileCache:
                 self._entries[key] = entry
             self._entries.move_to_end(key)
             while len(self._entries) > self._max_entries:
-                self._entries.popitem(last=False)
+                evicted.append(self._entries.popitem(last=False)[0])
                 self.evictions += 1
-            return new
+        if evicted:
+            # keep the ledger's executable table in step with the LRU
+            try:
+                from bigdl_tpu.obs.ledger import get_ledger
+                led = get_ledger()
+                for k in evicted:
+                    led.release_executable(self.ledger_tag,
+                                           self._ledger_key(k))
+            except Exception:
+                pass
+        return new
 
     def __call__(self, params, buffers, x):
         """Run ``fn`` through the cached executable for x's shape
@@ -162,4 +197,5 @@ class CompileCache:
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "hit_rate": (self.hits / total) if total else None,
+                "ledger_tag": self.ledger_tag,
             }

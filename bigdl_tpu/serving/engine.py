@@ -179,7 +179,8 @@ class ServingEngine:
 
         self.cache = CompileCache(
             _infer, max_entries=max_cache_entries, donate_x=donate_x,
-            placement_tag=placement.tag if placement is not None else "")
+            placement_tag=placement.tag if placement is not None else "",
+            name=f"serve/{name}/infer")
         self.stager = HostStager(
             self._dtype, chunk_bytes=chunk_bytes,
             device=placement.input_sharding() if placement is not None
@@ -187,6 +188,30 @@ class ServingEngine:
         # live metrics, published into the process-wide obs registry
         # (latest engine owns the serving/* names)
         self.metrics = ServingMetrics().publish_to(get_registry())
+        # memory-ledger attribution: staged params per placement slot
+        # plus the stager's cumulative transfer traffic
+        self._ledger_keys = []
+        try:
+            import weakref as _weakref
+
+            from bigdl_tpu.obs.ledger import get_ledger
+            from bigdl_tpu.quant import params_nbytes as _pnb
+            led = get_ledger()
+            _dev = placement.tag if placement is not None else None
+            self._ledger_keys.append(led.register(
+                "params", f"{name}/staged", _pnb(self._params),
+                device=_dev, note=f"quant={self.quant_dtype}"))
+            _stager_ref = _weakref.ref(self.stager)
+
+            def _staged_bytes():
+                st = _stager_ref()
+                return st.bytes_staged if st is not None else None
+
+            self._ledger_keys.append(led.register(
+                "host_stager", f"{name}/bytes_staged", _staged_bytes,
+                device=_dev, note="cumulative h2d traffic"))
+        except Exception:
+            pass
         # dispatch-cadence stall detection: a device call that hangs
         # (the tunneled-backend wedge) fires diagnose_tpu + stack dumps
         # into the trace instead of silently stalling every client
@@ -333,6 +358,13 @@ class ServingEngine:
         self._closed = True
         if self.batcher is not None:
             self.batcher.close(timeout=timeout)
+        try:
+            from bigdl_tpu.obs.ledger import get_ledger
+            led = get_ledger()
+            for sub, nm in getattr(self, "_ledger_keys", []):
+                led.release(sub, nm)
+        except Exception:
+            pass
 
     def __enter__(self) -> "ServingEngine":
         return self

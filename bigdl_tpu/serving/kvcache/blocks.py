@@ -148,13 +148,24 @@ class BlockPool:
         return self.capacity - self.free_count
 
     @property
+    def kv_arena_bytes(self) -> int:
+        """HBM footprint of the k + v data arenas alone."""
+        return 2 * self.k.size * self.k.dtype.itemsize
+
+    @property
+    def scale_arena_bytes(self) -> int:
+        """HBM footprint of the int8 per-(position, head) scale arenas
+        (0 for a full-precision pool) — ledgered separately from the
+        data arenas so quantized capacity planning sees the overhead."""
+        if self.ks is None:
+            return 0
+        return 2 * self.ks.size * self.ks.dtype.itemsize
+
+    @property
     def arena_bytes(self) -> int:
         """HBM footprint of the k + v arenas (+ scale arenas when
         quantized)."""
-        n = 2 * self.k.size * self.k.dtype.itemsize
-        if self.ks is not None:
-            n += 2 * self.ks.size * self.ks.dtype.itemsize
-        return n
+        return self.kv_arena_bytes + self.scale_arena_bytes
 
     def utilization(self) -> float:
         return self.used_count / self.capacity if self.capacity else 0.0

@@ -137,6 +137,30 @@ class HostBlockStore:
         reg.register(p + "entries",
                      FnGauge(lambda: len(self._entries)), replace=True)
         self._promote_s = 0.0    # cumulative promote host-read seconds
+        # memory-ledger attribution: host-tier residency + the
+        # cumulative promotion traffic the HBM side re-admitted
+        try:
+            import weakref
+
+            from bigdl_tpu.obs.ledger import get_ledger
+            led = get_ledger()
+            ref = weakref.ref(self)
+
+            def _host_resident():
+                s = ref()
+                return s._host_used if s is not None else None
+
+            def _promoted():
+                s = ref()
+                return (int(s.promoted_bytes.get()[0])
+                        if s is not None else None)
+
+            led.register("kvtier", f"{name}/host_resident",
+                         _host_resident, note="host RAM tier payloads")
+            led.register("kvtier", f"{name}/promoted_bytes", _promoted,
+                         note="cumulative tier->HBM promotion traffic")
+        except Exception:
+            pass
 
     # -- demotion (pool -> host tier) ----------------------------------- #
     def put(self, key: tuple, payload: dict) -> None:

@@ -692,7 +692,8 @@ class LMServingEngine:
                                              x["ids"], x["len"] - 1))
 
         self.prefill_cache = CompileCache(
-            _prefill_fn, max_entries=max_cache_entries, placement_tag=_ptag)
+            _prefill_fn, max_entries=max_cache_entries, placement_tag=_ptag,
+            name=f"lm/{name}/prefill")
 
         def _prefix_prefill_fn(params, buffers, x):
             del buffers
@@ -707,7 +708,7 @@ class LMServingEngine:
 
         self.prefix_prefill_cache = CompileCache(
             _prefix_prefill_fn, max_entries=max_cache_entries,
-            placement_tag=_ptag)
+            placement_tag=_ptag, name=f"lm/{name}/prefix_prefill")
 
         if decode_attn not in ("auto", "gather", "paged_kernel"):
             raise ValueError(f"decode_attn must be 'auto', 'gather' or "
@@ -830,6 +831,56 @@ class LMServingEngine:
             get_registry(), prefix=metrics_prefix)
         self.metrics.spec = self.spec_metrics
         self._publish_kv_metrics(get_registry())
+
+        # memory-ledger attribution: KV arenas (+ int8 scale arenas),
+        # staged params per placement slot, and the drafter's dense
+        # arena.  Providers are weakref'd — a closed, collected engine's
+        # bytes drop out of the table instead of pinning the arrays.
+        self._ledger_keys: List[tuple] = []
+        try:
+            import weakref as _weakref
+
+            from bigdl_tpu.obs.ledger import get_ledger
+            from bigdl_tpu.quant import params_dtype_tag, params_nbytes
+            led = get_ledger()
+            _dev = placement.tag if placement is not None else None
+            _pool_ref = _weakref.ref(self.pool)
+
+            def _kv_bytes():
+                p = _pool_ref()
+                return p.kv_arena_bytes if p is not None else None
+
+            self._ledger_keys.append(led.register(
+                "kvcache", f"{name}/kv_arena", _kv_bytes,
+                shape=self.pool.shape, dtype=str(self.pool.dtype),
+                device=_dev))
+            if self.kv_quant is not None:
+                def _scale_bytes():
+                    p = _pool_ref()
+                    return (p.scale_arena_bytes if p is not None
+                            else None)
+
+                self._ledger_keys.append(led.register(
+                    "kvcache", f"{name}/scale_arena", _scale_bytes,
+                    shape=self.pool.shape[:4], dtype="float32",
+                    device=_dev))
+            self._ledger_keys.append(led.register(
+                "params", f"{name}/staged",
+                params_nbytes(self._params), device=_dev,
+                note=f"quant={params_dtype_tag(self._params)}"))
+            if self.draft is not None:
+                _draft_ref = _weakref.ref(self.draft)
+
+                def _draft_bytes():
+                    d = _draft_ref()
+                    return d.arena_bytes if d is not None else None
+
+                self._ledger_keys.append(led.register(
+                    "spec", f"{name}/draft_arena", _draft_bytes,
+                    shape=self.draft.k.shape,
+                    dtype=str(self.draft.k.dtype), device=_dev))
+        except Exception:
+            log.exception("memory-ledger registration failed")
 
         # -- scheduler state (worker thread owns the slots) ------------- #
         self._cv = threading.Condition()
@@ -991,6 +1042,8 @@ class LMServingEngine:
             if self.kv_quant is not None:
                 args += [self.pool.ks, self.pool.vs]
             self._decode_exec = self._decode_jit.lower(*args).compile()
+            self._ledger_exec("decode", f"slots={self.slots}",
+                              self._decode_exec)
         return self._decode_exec
 
     def _verify_compiled(self):
@@ -1013,6 +1066,8 @@ class LMServingEngine:
                 args += [self.pool.ks, self.pool.vs]
             self._verify_exec = self._verify_jit.lower(*args).compile()
             self._verify_compiles += 1
+            self._ledger_exec("verify", f"slots={self.slots}",
+                              self._verify_exec)
         return self._verify_exec
 
     def _insert_compiled(self, bucket: int):
@@ -1035,7 +1090,18 @@ class LMServingEngine:
                 args += [scale, scale]
             exe = self._insert_jit.lower(*args).compile()
             self._insert_execs[bucket] = exe
+            self._ledger_exec("insert", f"bucket={bucket}", exe)
         return exe
+
+    def _ledger_exec(self, which: str, key: str, exe) -> None:
+        """File a directly-lowered executable's cost/memory row with
+        the memory ledger (best effort — never breaks a compile)."""
+        try:
+            from bigdl_tpu.obs.ledger import get_ledger
+            get_ledger().record_compiled(f"lm/{self.name}/{which}", key,
+                                         exe)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------ #
     def bucket_for(self, prompt_len: int) -> int:
@@ -1308,6 +1374,12 @@ class LMServingEngine:
                         for slot, hib in reversed(deferred_resumes):
                             self._free.append(slot)
                             self._resume_q.appendleft(hib)
+                        if not self._n_active and not self._prefilling:
+                            # nothing in flight to free capacity (a
+                            # ledger-watermark deferral with idle
+                            # slots): wait briefly instead of spinning
+                            # on the retry
+                            self._cv.wait(0.05)
                 if self._hibernate_req:
                     self._service_hibernations()
                 if self._chunk_cap is not None and self._prefilling:
@@ -1336,10 +1408,30 @@ class LMServingEngine:
             return
         self._fail_all(ServingClosed("engine closed before completion"))
 
+    def _mem_pressure_deferred(self) -> bool:
+        """Byte-level admission gate: when the memory ledger reads the
+        device past its used-fraction watermark, defer the admission
+        exactly like pool pressure — and let the ledger dump ONE
+        ``mem_pressure`` flight bundle while the attribution table can
+        still be written (a RESOURCE_EXHAUSTED later could not)."""
+        try:
+            from bigdl_tpu.obs.ledger import get_ledger
+            led = get_ledger()
+            if led.over_watermark():
+                led.check_pressure(
+                    context={"site": f"lm_admission/{self.name}"})
+                return True
+        except Exception:
+            pass
+        return False
+
     def _admit(self, slot: int, req: _Request) -> bool:
         """Prefill + insert one request into ``slot``.  Returns False
-        (defer) when the pool can't supply its blocks right now, even
-        after evicting unreferenced radix tails."""
+        (defer) when the pool can't supply its blocks right now — even
+        after evicting unreferenced radix tails — or when the memory
+        ledger reports device bytes past the watermark."""
+        if self._mem_pressure_deferred():
+            return False
         t = req.prompt0.shape[0]
         B = self.block_len
         need_total = self.pool.blocks_for(t + req.max_new)
@@ -2340,6 +2432,16 @@ class LMServingEngine:
             self._worker.join(5.0)
             self._fail_all(ServingClosed("engine closed before "
                                          "completion"))
+        # drop this engine's memory-ledger attributions (the weakref
+        # providers would go stale anyway; explicit release keeps the
+        # table clean for the next engine)
+        try:
+            from bigdl_tpu.obs.ledger import get_ledger
+            led = get_ledger()
+            for sub, nm in getattr(self, "_ledger_keys", []):
+                led.release(sub, nm)
+        except Exception:
+            pass
 
     def __enter__(self) -> "LMServingEngine":
         return self

@@ -35,6 +35,16 @@ from bigdl_tpu.serving.compile_cache import CompileCache
 from bigdl_tpu.serving.spec.verify import draft_pick
 
 
+def _ledger_record(tag: str, key: str, compiled) -> None:
+    """File a directly-lowered executable's cost/memory row (best
+    effort — the ledger must never break a compile path)."""
+    try:
+        from bigdl_tpu.obs.ledger import get_ledger
+        get_ledger().record_compiled(tag, key, compiled)
+    except Exception:
+        pass
+
+
 def _insert_slot_dense(k_cache, v_cache, k_new, v_new, slot):
     """Write a prefilled prompt's k/v (L, 1, H, Tb, D) into one slot's
     rows of the dense caches (L, S, H, C+1, D), starting at position 0.
@@ -106,7 +116,7 @@ class DraftModel:
 
         self.prefill_cache = CompileCache(
             _prefill_fn, max_entries=max_cache_entries,
-            placement_tag=placement_tag)
+            placement_tag=placement_tag, name="draft/prefill")
 
         def _decode_fn(params, token, pos, kc, vc):
             return _decode_step_slots(model, dequantize_entry(params),
@@ -119,6 +129,11 @@ class DraftModel:
         self._insert_execs: dict = {}
         self._st: List[Optional[_DraftSlot]] = [None] * self.slots
 
+    @property
+    def arena_bytes(self) -> int:
+        """HBM footprint of the drafter's dense k + v scratch arena."""
+        return 2 * self.k.size * self.k.dtype.itemsize
+
     # -- device programs ------------------------------------------------ #
     def _decode_compiled(self):
         if self._decode_exec is None:
@@ -130,6 +145,8 @@ class DraftModel:
             self._decode_exec = self._decode_jit.lower(
                 self._params, tok, pos, kc, kc).compile()
             self.decode_compiles += 1
+            _ledger_record("draft/decode", f"slots={self.slots}",
+                           self._decode_exec)
         return self._decode_exec
 
     def _insert_compiled(self, bucket: int):
@@ -144,6 +161,7 @@ class DraftModel:
                 cache, cache, new, new,
                 sds((), np.int32)).compile()
             self._insert_execs[bucket] = exe
+            _ledger_record("draft/insert", f"bucket={bucket}", exe)
         return exe
 
     def warmup(self) -> int:

@@ -86,6 +86,18 @@ class SLOController:
             ``start_level`` with ``set_admission`` wired applies the
             starting bound immediately so engine state and controller
             state agree.
+        ledger: optional
+            :class:`~bigdl_tpu.obs.ledger.MemoryLedger` (or anything
+            with ``over_watermark() -> bool``).  When wired, a tick
+            that would scale up first consults the ledger's byte-level
+            headroom: past the ``BIGDL_TPU_MEM_WATERMARK``
+            used-fraction watermark the controller REFUSES to add
+            capacity (new slots/replicas would only hasten
+            RESOURCE_EXHAUSTED) and falls through to admission
+            control; a later cool window re-arms scaling as usual.
+            This replaces ad-hoc per-subsystem checks inside
+            ``scale_up`` hooks with the process-wide attribution
+            plane.
         rejections: optional callable returning the CUMULATIVE shed
             count (e.g. the ``serving/rejected_total`` counter's
             value).  When wired, the controller refuses to relax while
@@ -111,6 +123,7 @@ class SLOController:
                  hot_streak: int = 2,
                  cool_streak: int = 4,
                  start_level: int = 0,
+                 ledger=None,
                  rejections: Optional[Callable[[], float]] = None,
                  shed_free_intervals: Optional[int] = None):
         if target_p99_s <= 0:
@@ -123,6 +136,7 @@ class SLOController:
         self.scale_up = scale_up
         self.scale_down = scale_down
         self.set_admission = set_admission
+        self.ledger = ledger
         self.admission_levels = [int(v) for v in admission_levels]
         self.hot_streak = int(hot_streak)
         self.cool_streak = int(cool_streak)
@@ -185,11 +199,27 @@ class SLOController:
             self.actions.append(out)
         return out
 
+    def _mem_denied(self) -> bool:
+        """True when the memory ledger reads the device past its
+        used-fraction watermark — adding capacity under byte pressure
+        trades a latency miss for an OOM kill."""
+        if self.ledger is None:
+            return False
+        try:
+            return bool(self.ledger.over_watermark())
+        except Exception:
+            return False
+
     def _tighten(self) -> str:
         if not self._scaling_exhausted and self.scale_up is not None:
-            if self.scale_up():
+            if self._mem_denied():
+                # refuse to add slots below the byte watermark; a cool
+                # window's rearm_scaling retries once pressure clears
+                self._scaling_exhausted = True
+            elif self.scale_up():
                 return "scale_up"
-            self._scaling_exhausted = True   # fall through to admission
+            else:
+                self._scaling_exhausted = True  # fall through to admission
         if self.set_admission is not None and \
                 self._level < len(self.admission_levels) - 1:
             self._level += 1

@@ -350,7 +350,30 @@ class Engine:
         except OSError:
             pass
         notes.extend(Engine._diagnose_tunnel())
+        notes.extend(Engine._diagnose_memory())
         return "; ".join(notes) if notes else "no stale TPU holder found"
+
+    @staticmethod
+    def _diagnose_memory() -> list:
+        """Memory-ledger capacity state for stall/flight dumps.  Reads
+        only the ledger's host-side totals and its LAST reconcile
+        verdict — never the jax backend (this report must stay safe to
+        produce while the chip is wedged)."""
+        try:
+            from bigdl_tpu.obs.ledger import get_ledger
+            s = get_ledger().summary()
+        except Exception:
+            return []
+        if not s["entries"] and not s["executables"]:
+            return []   # nothing registered: keep the report terse
+        last = s.get("last_reconcile") or {}
+        drift = last.get("drift_bytes")
+        verdict = last.get("verdict", "never_run")
+        return [f"memory: ledger={s['ledger_bytes']}B across "
+                f"{s['subsystems']} subsystems, "
+                f"{s['executables']} executables, "
+                f"drift={drift if drift is not None else 'n/a'} "
+                f"({verdict})"]
 
     @staticmethod
     def _diagnose_tunnel() -> list:

@@ -62,6 +62,7 @@ if [ "$SMOKE" = "1" ]; then
   SPEC_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1"
   QCOMPUTE_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1 --duel-iters 2"
   KVTIER_ARGS="--probes 2 --slots 2 --cache-len 64 --block-len 8 --sessions 6 --rounds 2 --timing-samples 3"
+  MEMPROFILE_ARGS="--requests 4 --slots 2 --cache-len 64 --block-len 8 --spec-k 2"
   PREFIX_ARGS="--requests 6 --slots 2 --cache-len 96 --shared-len 32 --mean-gap-ms 5 --probes 1"
   DISAGG_ARGS="--requests 8 --slots 4 --cache-len 128 --chunk-tokens 16 --mean-gap-ms 5 --probes 1"
   SLO_ARGS="--loads 4,8 --duration 1.5 --chaos-duration 2 --chaos-rps 15 --slots 2 --cache-len 64"
@@ -86,6 +87,7 @@ else
   SPEC_ARGS="--requests 24 --slots 8 --cache-len 128"
   QCOMPUTE_ARGS="--requests 24 --slots 8 --cache-len 128"
   KVTIER_ARGS=""
+  MEMPROFILE_ARGS="--requests 8 --slots 4 --cache-len 128"
   PREFIX_ARGS="--requests 24 --slots 8 --cache-len 128 --shared-len 64"
   DISAGG_ARGS="--requests 24 --slots 8 --cache-len 128 --chunk-tokens 32"
   SLO_ARGS="--loads 4,8,16,32,64 --duration 5 --chaos-duration 8"
@@ -126,8 +128,8 @@ ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json TUNE_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
 BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json BENCH_MESH.json \
 BENCH_SPEC.json BENCH_DISAGG.json BENCH_QCOMPUTE.json \
-BENCH_KVTIER.json \
-FLIGHT_*.json TRACE_*.json \
+BENCH_KVTIER.json PROFILE_MEM.json \
+flight/FLIGHT_*.json TRACE_*.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
 SCALING_resnet50_predicted.json SCALING_vgg16_predicted.json"
@@ -361,6 +363,30 @@ kvtier_stage() {
   return 1
 }
 
+# memprofile rides right after kvtier: it builds the whole serving
+# stack (batch engine, LM engine with int8 drafter + host KV tier) and
+# snapshots the memory ledger — on a real chip the reconciliation runs
+# against the actual HBM allocator (memory_stats().bytes_in_use), so
+# drift_bytes becomes chip evidence instead of the CPU degrade verdict,
+# and every executable's memory_analysis/cost_analysis row reflects the
+# TPU compiler.  Transfers are the same ~1 MB params the serving stages
+# already move, far below the 32 MB relay ceiling.  Same ok_lm gate
+# (the committed CPU PROFILE_MEM.json must never mark the TPU stage
+# done) and the same never-gates-the-round contract.
+memprofile_stage() {
+  ok_lm PROFILE_MEM.json && return 0
+  say "stage memprofile: firing (budget 600s): python -u bench.py --memprofile $MEMPROFILE_ARGS"
+  timeout 600 python -u bench.py --memprofile $MEMPROFILE_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm PROFILE_MEM.json; then
+    say "stage memprofile: DONE"
+    return 0
+  fi
+  say "stage memprofile: not done (rc=$rc)"
+  record_incident memprofile "$rc"
+  return 1
+}
+
 # mesh rides right after serve-lm: it proves the placement subsystem
 # against the REAL device set (TP-slot carving + sharded param staging
 # through the chunked relay discipline) — on a multi-chip window the
@@ -513,6 +539,7 @@ while :; do
     spec_stage
     qcompute_stage
     kvtier_stage
+    memprofile_stage
     mesh_stage
     prefix_stage
     disagg_stage

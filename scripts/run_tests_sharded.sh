@@ -27,7 +27,7 @@ N=${N:-3}
 # burns minutes producing evidence nothing can read.
 if [ "${OBS_GATE:-1}" = "1" ]; then
   python -m pytest tests/test_obs.py tests/test_flight.py \
-    -q -m "not slow" || exit 1
+    tests/test_memledger.py -q -m "not slow" || exit 1
 fi
 
 if [ "${FAULTS_GATE:-1}" = "1" ]; then

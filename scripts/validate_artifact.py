@@ -37,7 +37,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 #: repo-root artifact families under the resumable-measurement contract
 PATTERNS = ("BENCH_*.json", "TUNE_*.json", "PROFILE_*.json",
-            "TRACE_*.json", "FLIGHT_*.json")
+            "TRACE_*.json", "FLIGHT_*.json",
+            os.path.join("flight", "FLIGHT_*.json"))
 
 #: FlightRecorder bundle contract (bigdl_tpu.obs.flight._dump): every
 #: key must be present — a partial bundle means the dump died mid-write
@@ -286,6 +287,53 @@ def _kvtier_problems(doc) -> list:
     return probs
 
 
+def _memprofile_problems(doc) -> list:
+    """PROFILE_MEM.json extras: the memory-ledger profile is only
+    evidence when the attribution actually happened — a complete doc
+    must carry a nonempty subsystem->bytes attribution table, at least
+    one executable cost row, and a numeric reconciliation drift (the
+    CPU degrade path still reports drift_bytes == 0, never null)."""
+    probs = []
+    if doc.get("error"):
+        return probs
+    rows = {r.get("stage"): r for r in doc.get("rows", [])
+            if isinstance(r, dict)}
+    for i, r in enumerate(doc.get("rows", [])):
+        if isinstance(r, dict) and "stage" not in r:
+            probs.append("memprofile row %d lacks a 'stage' key" % i)
+    if doc.get("complete") is not True:
+        return probs
+    attr = (rows.get("attribution") or {}).get("attribution")
+    if not isinstance(attr, dict) or not attr:
+        probs.append("complete memprofile artifact: attribution row "
+                     "must carry a nonempty subsystem->bytes table, "
+                     "got %r" % (attr,))
+    elif not all(isinstance(v, (int, float)) for v in attr.values()):
+        probs.append("complete memprofile artifact: attribution "
+                     "values must be numeric byte counts")
+    exe = rows.get("executables")
+    if not isinstance(exe, dict) or not exe.get("rows"):
+        probs.append("complete memprofile artifact: executables row "
+                     "must carry at least one cost row")
+    rec = rows.get("reconciliation")
+    if not isinstance(rec, dict) \
+            or not isinstance(rec.get("drift_bytes"), (int, float)) \
+            or isinstance(rec.get("drift_bytes"), bool):
+        probs.append("complete memprofile artifact: reconciliation "
+                     "row must carry numeric drift_bytes, got %r"
+                     % ((rec or {}).get("drift_bytes"),))
+    elif rec.get("verdict") not in ("reconciled", "degraded"):
+        probs.append("complete memprofile artifact: reconciliation "
+                     "verdict must be 'reconciled' or 'degraded', "
+                     "got %r" % (rec.get("verdict"),))
+    summ = doc.get("summary")
+    if not isinstance(summ, dict) \
+            or not isinstance(summ.get("subsystems"), int):
+        probs.append("complete memprofile artifact lacks "
+                     "summary.subsystems")
+    return probs
+
+
 def _problems(doc, name: str = "") -> list:
     """Contract violations for one parsed artifact document."""
     probs = []
@@ -323,6 +371,8 @@ def _problems(doc, name: str = "") -> list:
             probs.extend(_qcompute_problems(doc))
         if name == "BENCH_KVTIER.json":
             probs.extend(_kvtier_problems(doc))
+        if name == "PROFILE_MEM.json":
+            probs.extend(_memprofile_problems(doc))
         return probs
     if "metric" not in doc:
         probs.append("no 'rows', no supervisor record, no 'metric' key "

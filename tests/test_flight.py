@@ -200,10 +200,12 @@ def test_bundle_schema_pointer_and_correlation(tmp_path, recorder,
     assert doc["state"]["pool"] == {"free": 7}
     assert doc["active_requests"]["eng"] == ["r1-1", "r1-2"]
     assert isinstance(doc["diagnose_tpu"], str)
-    # ledger row cross-references the bundle
+    # ledger row cross-references the bundle: the pointer must resolve
+    # to the bundle file (relative to cwd for in-tree flight/ dirs,
+    # absolute for out-of-tree ones like this tmp dir)
     ledger = json.loads(open(recorder.incidents_path).read())
     (row,) = ledger["incidents"]
-    assert row["flight"] == os.path.basename(path)
+    assert os.path.abspath(row["flight"]) == os.path.abspath(path)
     assert row["stage"] == "flight/backend_lost" and row["rc"] == 0
 
 

@@ -208,7 +208,8 @@ def test_compile_cache_counters_and_warmup():
     y = cache(model.params, model.buffers, x)
     assert y.shape == (4, 4)
     assert cache.stats() == {"entries": 2, "hits": 1, "misses": 0,
-                             "evictions": 0, "hit_rate": 1.0}
+                             "evictions": 0, "hit_rate": 1.0,
+                             "ledger_tag": "infer"}
     cache(model.params, model.buffers, jnp.ones((2, 8), jnp.float32))
     s = cache.stats()
     assert s["misses"] == 1 and s["entries"] == 3
