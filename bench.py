@@ -2350,6 +2350,374 @@ def _serve_lm_kvtier_bench(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --serve-lm --router: prefix-affinity routing -> BENCH_ROUTER.json
+# ---------------------------------------------------------------------------
+
+def _serve_lm_router_bench(argv) -> int:
+    """Cache-aware routing benchmark -> BENCH_ROUTER.json (resumable).
+
+    One returning-session trace (S sessions x T turns; every turn's
+    prompt is the previous turn's full output plus fresh user tokens),
+    replayed through three LMReplicaSet arms:
+
+    - ``blind``: router=None — the radix-blind least-loaded baseline.
+      Each replica grows its own RadixCache, so a returning session
+      lands wherever the queue is shortest and re-prefills tokens
+      another replica already holds.
+    - ``routed``: RadixRouter prefix-affinity scoring over the
+      per-replica summaries (no session ids — this arm measures the
+      SCORE, not stickiness).  Gate: set-level prefix hit rate
+      strictly above blind AND TTFT p99 strictly below blind.
+    - ``chaos``: routed + session stickiness + per-replica host tiers;
+      one replica is killed mid-trace with a session hibernated into
+      it.  Gate: zero accepted-request loss (every stream completes,
+      the hibernated session re-routes and replays bit-exactly) and
+      re_routes >= 1.
+
+    AGREEMENT artifact: every arm's every output must equal the
+    single-engine reference (same prompt, seed, temperature) exactly —
+    ``complete`` requires agreement 1.0 on every stage."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --serve-lm --router")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--sessions", type=int, default=int(
+        os.environ.get("BIGDL_TPU_ROUTER_SESSIONS", "6")))
+    ap.add_argument("--turns", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--cache-len", type=int, default=1024)
+    ap.add_argument("--block-len", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=8)
+    ap.add_argument("--prompt-blocks", type=int, default=32,
+                    help="session head length in blocks — long heads "
+                         "make TTFT prefill-dominated, which is the "
+                         "regime affinity routing targets (short heads "
+                         "drown the saved prefill in decode noise)")
+    ap.add_argument("--affinity-weight", type=float, default=0.7)
+    args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_ROUTER.json")
+    if args.turns < 2 or args.sessions < 2 or args.replicas < 2:
+        ap.error("need >= 2 sessions, >= 2 turns, >= 2 replicas")
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import jax
+    import numpy as np
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.serving import HostBlockStore, LMServingEngine
+    from bigdl_tpu.serving.router import LMReplicaSet, RadixRouter
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    config = {"model": "transformer_lm", "vocab": 256, "hidden": 128,
+              "heads": 4, "layers": 4, "max_len": args.cache_len,
+              "pos": "rope", "layout": "paged",
+              "slots": args.slots, "cache_len": args.cache_len,
+              "block_len": args.block_len, "max_new": args.max_new,
+              "sessions": args.sessions, "turns": args.turns,
+              "replicas": args.replicas,
+              "prompt_blocks": args.prompt_blocks,
+              "affinity_weight": args.affinity_weight}
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "lm_serving_router", "platform": platform,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+    model = TransformerLM(
+        vocab_size=config["vocab"], hidden_size=config["hidden"],
+        n_head=config["heads"], n_layers=config["layers"],
+        max_len=args.cache_len, pos_encoding="rope").build(seed=7)
+    eng_kw = dict(slots=args.slots, cache_len=args.cache_len,
+                  block_len=args.block_len, max_new_tokens=args.max_new,
+                  temperature=0.7,
+                  max_queue=max(args.sessions * args.turns, 256))
+    TEMP, TIMEOUT = 0.7, 600.0
+
+    def seed(s, t):
+        return 1000 * s + t   # one deterministic key chain per request
+
+    # -- the trace + its single-engine reference outputs ----------------- #
+    # Built once: turn t's prompt is turn t-1's full reference output
+    # plus a fresh user suffix, so the SAME prompts replay through
+    # every arm and bit-exactness is checkable per request.
+    rng = np.random.RandomState(11)
+    suffix = args.block_len + 1   # user turns cross a block boundary
+    trace = [[None] * args.sessions for _ in range(args.turns)]
+    refs = [[None] * args.sessions for _ in range(args.turns)]
+    ref_eng = LMServingEngine(model, **eng_kw)
+    try:
+        ref_eng.warmup()
+        head = args.prompt_blocks * args.block_len + 1
+        max_prompt = (head + args.turns * (args.max_new + suffix)
+                      + args.max_new)
+        if max_prompt > args.cache_len:
+            ap.error(f"trace would outgrow cache_len "
+                     f"({max_prompt} > {args.cache_len}): shrink "
+                     f"--prompt-blocks/--turns/--max-new")
+        hist = [rng.randint(1, config["vocab"] + 1,
+                            size=head).astype(np.int32)
+                for _ in range(args.sessions)]
+        for t in range(args.turns):
+            for s in range(args.sessions):
+                trace[t][s] = hist[s]
+                out = ref_eng.generate(hist[s], max_new_tokens=args.max_new,
+                                       temperature=TEMP, rng=seed(s, t),
+                                       timeout=TIMEOUT)
+                refs[t][s] = out
+                hist[s] = np.concatenate(
+                    [out, rng.randint(1, config["vocab"] + 1,
+                                      size=suffix)]).astype(np.int32)
+        # the chaos stage's long-running hibernation session
+        hib_prompt = rng.randint(1, config["vocab"] + 1,
+                                 size=3 * args.block_len + 1) \
+            .astype(np.int32)
+        hib_max_new = min(48, args.cache_len - len(hib_prompt))
+        hib_ref = ref_eng.generate(hib_prompt, max_new_tokens=hib_max_new,
+                                   temperature=TEMP, rng=99999,
+                                   timeout=TIMEOUT)
+    finally:
+        ref_eng.close()
+
+    # (suffix-length, chain-depth) pairs the trace can hit: warm the
+    # prefix-prefill executables on EVERY arm before the timed replay,
+    # so TTFT measures routing, not first-use XLA compiles (both arms
+    # get the identical warmup — the comparison stays fair)
+    suffix_hints, chain_hints = set(), set()
+    for s in range(args.sessions):
+        depths = []           # chain depths this session ever published
+        for t in range(args.turns):
+            plen = len(trace[t][s])
+            cap = (plen - 1) // args.block_len
+            for d in depths:
+                m = min(cap, d)
+                if m >= 1:
+                    suffix_hints.add(plen - m * args.block_len)
+                    chain_hints.add(m)
+            depths.append((plen + args.max_new) // args.block_len)
+
+    def _warm(rset):
+        rset.warmup()
+        if suffix_hints:
+            rset.warmup_prefix(sorted(suffix_hints), sorted(chain_hints))
+
+    def _percentiles_ms(ttfts):
+        xs = [t for t in ttfts if t is not None]
+        if not xs:
+            return None, None
+        return (round(float(np.percentile(xs, 50)) * 1000.0, 3),
+                round(float(np.percentile(xs, 99)) * 1000.0, 3))
+
+    def _run_trace(rset, *, session_ids=False, kill_at_turn=None,
+                   kill_name=None):
+        """Replay the trace; returns (exact, total, losses, ttfts,
+        killed_name).  Turn t's streams are all in flight together, so
+        dispatch balance matters; the submission order ROTATES by turn
+        — deterministic least-loaded round-robin would otherwise
+        reproduce last turn's placement verbatim and hand the blind arm
+        perfect affinity by accident (a real front-end's arrival order
+        is not stable either).  The kill (when asked) lands while turn
+        ``kill_at_turn``'s streams are mid-decode."""
+        exact = total = losses = 0
+        ttfts = []
+        killed = None
+        for t in range(args.turns):
+            streams = [None] * args.sessions
+            for i in range(args.sessions):
+                s = (i + t) % args.sessions
+                sid = f"sess-{s}" if session_ids else None
+                streams[s] = rset.submit(
+                    trace[t][s], session_id=sid, temperature=TEMP,
+                    rng=seed(s, t))
+            if kill_at_turn is not None and t == kill_at_turn:
+                killed = kill_name or streams[t % args.sessions] \
+                    .replica_name
+                rset.kill_replica(killed)
+            for s, st in enumerate(streams):
+                total += 1
+                try:
+                    out = st.result(timeout=TIMEOUT)
+                except Exception:
+                    losses += 1
+                    continue
+                exact += int(np.array_equal(out, refs[t][s]))
+                # TTFT stats cover RETURNING turns only (t >= 1): turn
+                # 0 is a cold full prefill in every arm — routing
+                # cannot touch it — and on a short trace its queueing
+                # jitter owns the p99, drowning the suffix-only wins
+                # the gate is supposed to measure.
+                if t >= 1:
+                    ttfts.append(st.ttft_s)
+        return exact, total, losses, ttfts, killed
+
+    def _arm_stage(routed: bool):
+        router = (RadixRouter(affinity_weight=args.affinity_weight)
+                  if routed else None)
+        rset = LMReplicaSet(model, args.replicas, router=router,
+                            name="routed" if routed else "blind",
+                            **eng_kw)
+        try:
+            _warm(rset)
+            exact, total, losses, ttfts, _ = _run_trace(rset)
+            pc = rset.prefix_cache_stats()
+            p50, p99 = _percentiles_ms(ttfts)
+            row = {"requests": total,
+                   "agreement": round(exact / total, 4),
+                   "accepted_loss": losses,
+                   "prefix_hit_rate": round(pc["hit_rate"] or 0.0, 4),
+                   "prefill_tokens_saved": pc["prefill_tokens_saved"],
+                   "ttft_scope": "returning_turns",
+                   "ttft_p50_ms": p50, "ttft_p99_ms": p99}
+            if routed:
+                rst = rset.stats()["router"]
+                row.update(affinity_hits=rst["affinity_hits"],
+                           cold_dispatches=rst["cold_dispatches"])
+            return row
+        finally:
+            rset.close()
+
+    def _chaos_stage():
+        tier_mb = 256 << 20
+        rset = LMReplicaSet(
+            model, args.replicas,
+            router=RadixRouter(affinity_weight=args.affinity_weight),
+            kvtier_factory=lambda n: HostBlockStore(host_bytes=tier_mb,
+                                                    name=n),
+            name="chaos", **eng_kw)
+        try:
+            _warm(rset)
+            # a session hibernated into the victim: its tier entry dies
+            # with the replica, so resume must re-route + replay
+            hib = rset.submit(hib_prompt, session_id="hib-sess",
+                              max_new_tokens=hib_max_new,
+                              temperature=TEMP, rng=99999)
+            it = hib.tokens(timeout=TIMEOUT)
+            next(it)
+            next(it)
+            hibernated = rset.hibernate(hib, timeout=30.0)
+            victim = hib.replica_name
+            # the kill targets the hibernation holder: a DEAD sticky
+            # replica mid-trace, with a session's tier entry inside it
+            exact, total, losses, ttfts, killed = _run_trace(
+                rset, session_ids=True,
+                kill_at_turn=args.turns // 2, kill_name=victim)
+            resumed = rset.resume(hib)
+            total += 1
+            try:
+                hib_out = hib.result(timeout=TIMEOUT)
+                hib_exact = bool(np.array_equal(hib_out, hib_ref))
+                exact += int(hib_exact)
+            except Exception:
+                losses += 1
+                hib_exact = False
+            st = rset.stats()
+            return {"requests": total,
+                    "agreement": round(exact / total, 4),
+                    "accepted_loss": losses,
+                    "killed_replica": killed,
+                    "re_routes": st["sessions"]["re_routes"],
+                    "re_dispatches": hib.re_dispatches,
+                    "hibernated": bool(hibernated),
+                    "resumed": bool(resumed),
+                    "hibernated_resume_exact": hib_exact,
+                    "resume_re_routes": st["resume_re_routes"],
+                    "sticky_hits": st["sessions"]["sticky_hits"]}
+        finally:
+            rset.close()
+
+    stages = {"blind": lambda: _arm_stage(False),
+              "routed": lambda: _arm_stage(True),
+              "chaos": _chaos_stage}
+    for name, run in stages.items():
+        if name in prev:
+            row = dict(prev[name])
+            row["reused_from_previous_run"] = True
+        else:
+            row = {"stage": name, **run()}
+        rows.append(row)
+        flush()
+
+    blind = next(r for r in rows if r.get("stage") == "blind")
+    routed = next(r for r in rows if r.get("stage") == "routed")
+    chaos = next(r for r in rows if r.get("stage") == "chaos")
+    problems = []
+    for r in (blind, routed, chaos):
+        if r["agreement"] != 1.0:
+            problems.append("stage %s agreement %r != 1.0 — routed "
+                            "outputs diverged from the single-engine "
+                            "reference" % (r["stage"], r["agreement"]))
+    if routed["prefix_hit_rate"] <= blind["prefix_hit_rate"]:
+        problems.append(
+            "routed prefix hit rate %.3f not above blind %.3f — "
+            "affinity scoring bought nothing" %
+            (routed["prefix_hit_rate"], blind["prefix_hit_rate"]))
+    if (routed.get("ttft_p99_ms") and blind.get("ttft_p99_ms")
+            and routed["ttft_p99_ms"] >= blind["ttft_p99_ms"]):
+        problems.append(
+            "routed TTFT p99 (%.1f ms) did not beat blind (%.1f ms)"
+            % (routed["ttft_p99_ms"], blind["ttft_p99_ms"]))
+    if chaos["accepted_loss"] != 0:
+        problems.append("chaos stage lost %d accepted request(s)"
+                        % chaos["accepted_loss"])
+    if not chaos["re_routes"] and not chaos["resume_re_routes"]:
+        problems.append("chaos stage recorded no re-routes — the "
+                        "replica death was not exercised")
+    if not (chaos["hibernated"] and chaos["resumed"]
+            and chaos["hibernated_resume_exact"]):
+        problems.append(
+            "chaos stage: hibernated session did not survive its "
+            "replica's death (hibernated=%r resumed=%r exact=%r)"
+            % (chaos["hibernated"], chaos["resumed"],
+               chaos["hibernated_resume_exact"]))
+    if problems:
+        for p in problems:
+            print("bench: ROUTER GATE: " + p + " — artifact left "
+                  "incomplete", file=sys.stderr)
+        flush()
+        return 1
+    result["summary"] = {
+        "agreement": 1.0,
+        "prefix_hit_rate": {"blind": blind["prefix_hit_rate"],
+                            "routed": routed["prefix_hit_rate"]},
+        "ttft_p50_ms": {"blind": blind["ttft_p50_ms"],
+                        "routed": routed["ttft_p50_ms"]},
+        "ttft_p99_ms": {"blind": blind["ttft_p99_ms"],
+                        "routed": routed["ttft_p99_ms"]},
+        "ttft_p99_speedup": round(
+            blind["ttft_p99_ms"] / routed["ttft_p99_ms"], 3),
+        "affinity_hits": routed.get("affinity_hits"),
+        "cold_dispatches": routed.get("cold_dispatches"),
+        "chaos_zero_accepted_loss": chaos["accepted_loss"] == 0,
+        "chaos_re_routes": (chaos["re_routes"]
+                            + chaos["resume_re_routes"]),
+    }
+    result["complete"] = True
+    flush()
+    print(json.dumps({
+        "metric": "lm_serving_router_prefix_hit_rate",
+        "value": routed["prefix_hit_rate"],
+        "unit": "fraction", "platform": platform,
+        **{k: v for k, v in result["summary"].items()
+           if k != "prefix_hit_rate"},
+        "prefix_hit_rate_blind": blind["prefix_hit_rate"]}), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --serve-lm --disagg: disaggregated prefill/decode -> BENCH_DISAGG.json
 # ---------------------------------------------------------------------------
 
@@ -3244,6 +3612,10 @@ if __name__ == "__main__":
         sys.exit(_serve_lm_qcompute_bench(
             [a for a in sys.argv[1:]
              if a not in ("--serve-lm", "--spec", "--qcompute")]))
+    if "--serve-lm" in sys.argv and "--router" in sys.argv:
+        sys.exit(_serve_lm_router_bench(
+            [a for a in sys.argv[1:]
+             if a not in ("--serve-lm", "--router")]))
     if "--serve-lm" in sys.argv and "--kvtier" in sys.argv:
         sys.exit(_serve_lm_kvtier_bench(
             [a for a in sys.argv[1:]

@@ -52,8 +52,6 @@ Quickstart::
     reg.counter("app/requests").add(1)
     print(reg.snapshot())
 """
-from bigdl_tpu.obs.flight import (FlightRecorder, get_flight_recorder,
-                                  note_shed)
 from bigdl_tpu.obs.ledger import MemoryLedger, get_ledger, set_ledger
 from bigdl_tpu.obs.registry import (Counter, FnGauge, Gauge, Histogram,
                                     MetricRegistry, get_registry,
@@ -67,6 +65,22 @@ from bigdl_tpu.obs.tracer import (Tracer, get_tracer, mint_request_id,
 from bigdl_tpu.obs.watchdog import (StallWatchdog, env_watchdog_enabled,
                                     env_watchdog_kwargs, shared_watchdog,
                                     thread_stacks)
+
+# Flight names resolve lazily (PEP 562): an eager `from ...flight
+# import` here would put bigdl_tpu.obs.flight in sys.modules before
+# runpy executes it, so every `python -m bigdl_tpu.obs.flight dump`
+# (chip_opportunist's incident recorder) logged a RuntimeWarning about
+# the double import.  Everything else in the tree already imports
+# flight lazily; the package facade now does too.
+_FLIGHT_NAMES = ("FlightRecorder", "get_flight_recorder", "note_shed")
+
+
+def __getattr__(name):
+    if name in _FLIGHT_NAMES:
+        from bigdl_tpu.obs import flight
+        return getattr(flight, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "Tracer", "get_tracer", "mint_request_id",

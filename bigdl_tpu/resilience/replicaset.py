@@ -54,7 +54,123 @@ class _Replica:
         self.slot = slot  # MeshSlice under placement, else None
 
 
-class ReplicaSet:
+class ReplicaSetCore:
+    """The engine-agnostic half of a replica set: per-replica circuit
+    breakers, the half-open probe protocol, and replica selection with
+    an **injectable dispatch policy**.
+
+    :class:`ReplicaSet` (padded-batch serving) and the LM router's
+    ``LMReplicaSet`` both inherit this core, so breakers, bounded
+    re-dispatch accounting, and the pick/record state machine behave
+    identically whether the unit of dispatch is a batch or a stream.
+
+    ``dispatch_policy`` is ``policy(healthy, ctx) -> replica | None``:
+    called under the set lock with the non-excluded HEALTHY replicas
+    (half-open probes are arbitrated by the core first — a policy never
+    sees, and cannot starve, a probe) and a per-dispatch context dict.
+    Returning None — or a replica not in the candidate list — falls
+    back to least-loaded, so a policy can only ever *bias* placement,
+    never break liveness.  The default (None) is the original
+    least-loaded pick: lowest ``inflight``, ties broken by total
+    ``dispatched`` so serial traffic round-robins.
+    """
+
+    def _init_core(self, *, failure_threshold: int = 3,
+                   cooldown_s: float = 5.0,
+                   max_redispatch: int = 1,
+                   clock=time.monotonic,
+                   dispatch_policy=None) -> None:
+        from bigdl_tpu.obs import get_registry
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.max_redispatch = int(max_redispatch)
+        self._clock = clock
+        self.dispatch_policy = dispatch_policy
+        self._lock = threading.Lock()
+        self._registry = get_registry()
+        self._replicas: list = []
+
+    def _publish_replica_count(self) -> None:
+        n = sum(1 for r in self._replicas if r.state != DRAINING)
+        self._registry.gauge("resilience/replicas").set(n)
+
+    # ---------------------------------------------------------------- #
+    # health / breaker state machine (all transitions under _lock)     #
+    # ---------------------------------------------------------------- #
+    def _publish_open_circuits(self) -> None:
+        n_open = sum(1 for r in self._replicas
+                     if r.state in (OPEN, HALF_OPEN))
+        self._registry.gauge("resilience/open_circuits").set(n_open)
+
+    def _pick(self, exclude, ctx: Optional[dict] = None) \
+            -> Optional[_Replica]:
+        """A cooled-down open circuit gets one half-open probe dispatch
+        (even while healthy replicas exist — lost capacity must be able
+        to return); otherwise the dispatch policy chooses among healthy
+        replicas, defaulting to least-loaded with ties broken by total
+        work dispatched so serial traffic round-robins."""
+        with self._lock:
+            candidates = [r for r in self._replicas
+                          if r.name not in exclude and r.state != DRAINING]
+            pick = None
+            if not any(r.state == HALF_OPEN for r in self._replicas):
+                now = self._clock()
+                for r in candidates:
+                    if (r.state == OPEN
+                            and now - r.opened_at >= self.cooldown_s):
+                        r.state = HALF_OPEN  # one probe in flight at most:
+                        # a second probe needs this one to resolve first
+                        log.info("replica %s: circuit half-open (probe)",
+                                 r.name)
+                        pick = r
+                        break
+            if pick is None:
+                healthy = [r for r in candidates if r.state == HEALTHY]
+                if healthy:
+                    if self.dispatch_policy is not None:
+                        pick = self.dispatch_policy(healthy, ctx or {})
+                        if pick is not None and pick not in healthy:
+                            log.warning(
+                                "dispatch policy returned a non-candidate "
+                                "replica; falling back to least-loaded")
+                            pick = None
+                    if pick is None:
+                        pick = min(healthy,
+                                   key=lambda r: (r.inflight, r.dispatched))
+            if pick is not None:
+                pick.inflight += 1
+                pick.dispatched += 1
+            return pick
+
+    def _record_success(self, rep: _Replica) -> None:
+        with self._lock:
+            rep.inflight -= 1
+            rep.consecutive_failures = 0
+            if rep.state in (HALF_OPEN, OPEN):
+                log.info("replica %s: circuit closed (probe succeeded)",
+                         rep.name)
+            if rep.state != DRAINING:
+                rep.state = HEALTHY
+            self._publish_open_circuits()
+
+    def _record_failure(self, rep: _Replica, exc: BaseException) -> None:
+        with self._lock:
+            rep.inflight -= 1
+            rep.failures += 1
+            rep.consecutive_failures += 1
+            was = rep.state
+            if (rep.state == HALF_OPEN
+                    or rep.consecutive_failures >= self.failure_threshold):
+                rep.state = OPEN
+                rep.opened_at = self._clock()
+            if rep.state == OPEN and was != OPEN:
+                log.warning("replica %s: circuit OPEN after %d consecutive "
+                            "failures (%s)", rep.name,
+                            rep.consecutive_failures, exc)
+            self._publish_open_circuits()
+
+
+class ReplicaSet(ReplicaSetCore):
     """Serve a built module from ``n_replicas`` engines with failover.
 
     Args:
@@ -76,6 +192,9 @@ class ReplicaSet:
         max_redispatch: how many times one batch may be re-dispatched
             after a failure before the set gives up (default: try every
             replica once).
+        dispatch_policy: optional replica-selection policy (see
+            :class:`ReplicaSetCore`) — e.g. the serving router's
+            prefix-affinity scorer.  None keeps least-loaded.
         clock: injectable monotonic clock (tests drive breaker timing).
         placement: optional
             :class:`~bigdl_tpu.serving.placement.PlacementPolicy` — one
@@ -93,6 +212,7 @@ class ReplicaSet:
                  failure_threshold: int = 3,
                  cooldown_s: float = 5.0,
                  max_redispatch: Optional[int] = None,
+                 dispatch_policy=None,
                  clock=time.monotonic,
                  input_shape: Optional[tuple] = None,
                  buckets: Optional[Sequence[int]] = None,
@@ -117,19 +237,17 @@ class ReplicaSet:
             n_replicas = 2
         if n_replicas < 1:
             raise ValueError("n_replicas must be >= 1")
-        from bigdl_tpu.obs import get_registry
         from bigdl_tpu.serving.batcher import DynamicBatcher
         from bigdl_tpu.serving.engine import ServingEngine
         from bigdl_tpu.serving.metrics import ServingMetrics
         from bigdl_tpu.utils.engine import Engine
 
-        self.failure_threshold = int(failure_threshold)
-        self.cooldown_s = float(cooldown_s)
-        self.max_redispatch = (int(max_redispatch) if max_redispatch
-                               is not None else max(1, n_replicas - 1))
-        self._clock = clock
-        self._lock = threading.Lock()
-        self._registry = get_registry()
+        self._init_core(
+            failure_threshold=failure_threshold,
+            cooldown_s=cooldown_s,
+            max_redispatch=(int(max_redispatch) if max_redispatch
+                            is not None else max(1, n_replicas - 1)),
+            clock=clock, dispatch_policy=dispatch_policy)
         # kept for scale_to(): new replicas are built from the same
         # module (heterogeneous sets grow with their FIRST module) and
         # the same engine policy the constructor used
@@ -181,10 +299,6 @@ class ReplicaSet:
         except Exception:
             pass
 
-    def _publish_replica_count(self) -> None:
-        n = sum(1 for r in self._replicas if r.state != DRAINING)
-        self._registry.gauge("resilience/replicas").set(n)
-
     def _acquire_slot(self, *, required: bool):
         """One mesh slot from the placement policy; raises (required)
         or returns None (opportunistic growth) when the devices are
@@ -203,71 +317,6 @@ class ReplicaSet:
         if slot is not None:
             cfg["placement"] = slot
         return cfg
-
-    # ---------------------------------------------------------------- #
-    # health / breaker state machine (all transitions under _lock)     #
-    # ---------------------------------------------------------------- #
-    def _publish_open_circuits(self) -> None:
-        n_open = sum(1 for r in self._replicas
-                     if r.state in (OPEN, HALF_OPEN))
-        self._registry.gauge("resilience/open_circuits").set(n_open)
-
-    def _pick(self, exclude) -> Optional[_Replica]:
-        """A cooled-down open circuit gets one half-open probe batch
-        (even while healthy replicas exist — lost capacity must be able
-        to return); otherwise the least-loaded healthy replica, ties
-        broken by total work dispatched so serial traffic round-robins."""
-        with self._lock:
-            candidates = [r for r in self._replicas
-                          if r.name not in exclude and r.state != DRAINING]
-            pick = None
-            if not any(r.state == HALF_OPEN for r in self._replicas):
-                now = self._clock()
-                for r in candidates:
-                    if (r.state == OPEN
-                            and now - r.opened_at >= self.cooldown_s):
-                        r.state = HALF_OPEN  # one probe in flight at most:
-                        # a second probe needs this one to resolve first
-                        log.info("replica %s: circuit half-open (probe)",
-                                 r.name)
-                        pick = r
-                        break
-            if pick is None:
-                healthy = [r for r in candidates if r.state == HEALTHY]
-                if healthy:
-                    pick = min(healthy,
-                               key=lambda r: (r.inflight, r.dispatched))
-            if pick is not None:
-                pick.inflight += 1
-                pick.dispatched += 1
-            return pick
-
-    def _record_success(self, rep: _Replica) -> None:
-        with self._lock:
-            rep.inflight -= 1
-            rep.consecutive_failures = 0
-            if rep.state in (HALF_OPEN, OPEN):
-                log.info("replica %s: circuit closed (probe succeeded)",
-                         rep.name)
-            if rep.state != DRAINING:
-                rep.state = HEALTHY
-            self._publish_open_circuits()
-
-    def _record_failure(self, rep: _Replica, exc: BaseException) -> None:
-        with self._lock:
-            rep.inflight -= 1
-            rep.failures += 1
-            rep.consecutive_failures += 1
-            was = rep.state
-            if (rep.state == HALF_OPEN
-                    or rep.consecutive_failures >= self.failure_threshold):
-                rep.state = OPEN
-                rep.opened_at = self._clock()
-            if rep.state == OPEN and was != OPEN:
-                log.warning("replica %s: circuit OPEN after %d consecutive "
-                            "failures (%s)", rep.name,
-                            rep.consecutive_failures, exc)
-            self._publish_open_circuits()
 
     # ---------------------------------------------------------------- #
     # dispatch                                                         #

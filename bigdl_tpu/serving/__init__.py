@@ -34,6 +34,9 @@ from bigdl_tpu.serving.lm_engine import (KVHandoff, LMMetrics,
                                          LMServingEngine, LMStream,
                                          prefill_bucket_lengths)
 from bigdl_tpu.serving.metrics import LatencyHistogram, ServingMetrics
+from bigdl_tpu.serving.router import (LMReplicaSet, RadixRouter,
+                                      RadixSummary, RoutedLMStream,
+                                      SessionTable)
 from bigdl_tpu.serving.placement import (DeviceTopology, MeshSlice,
                                          MeshSlicer, PlacementError,
                                          PlacementPolicy, serving_tp_rules,
@@ -48,6 +51,8 @@ __all__ = [
     "DisaggCoordinator", "KVHandoff",
     "BlockPool", "RadixCache", "PoolExhausted", "RequestExceedsPool",
     "HostBlockStore",
+    "LMReplicaSet", "RoutedLMStream", "RadixRouter", "RadixSummary",
+    "SessionTable",
     "DeviceTopology", "MeshSlice", "MeshSlicer", "PlacementError",
     "PlacementPolicy", "serving_tp_rules", "shard_params_chunked",
     "SpecConfig", "DraftModel", "SpecMetrics",

@@ -52,17 +52,60 @@ from bigdl_tpu.serving.kvcache.blocks import BlockPool
 
 log = logging.getLogger("bigdl_tpu.serving")
 
+# Prefix fingerprints: every trie node carries a 64-bit FNV-1a chain
+# hash of its full root->node block-key path.  The router's per-replica
+# summary is just the SET of these sigs — membership of sig_i means "a
+# chain covering blocks [0, i] of some prompt is cached here" — so a
+# foreign router can measure longest-prefix overlap without walking (or
+# even seeing) the trie.  The hash is deterministic across processes
+# (no PYTHONHASHSEED dependence: plain int arithmetic).
+_SIG_ROOT = 0xCBF29CE484222325     # FNV-1a 64-bit offset basis
+_FNV_PRIME = 0x100000001B3
+_U64 = (1 << 64) - 1
+
+
+def _sig_extend(sig: int, key: Tuple[int, ...]) -> int:
+    """Fold one block's token tuple into a cumulative prefix sig.
+    Block keys have fixed length (``block_len``), so the chain hash is
+    unambiguous without separators."""
+    h = sig
+    for tok in key:
+        h = ((h ^ (int(tok) & _U64)) * _FNV_PRIME) & _U64
+    return h
+
+
+def prefix_signatures(tokens0, block_len: int,
+                      cap: Optional[int] = None) -> List[int]:
+    """Cumulative block-prefix sigs for a prompt (0-based ids):
+    ``out[i]`` fingerprints blocks ``[0, i]``.  ``cap`` defaults to the
+    same ``(t - 1) // block_len`` bound :meth:`RadixCache.match` uses —
+    the last prompt token is always prefilled, never matched."""
+    t = len(tokens0)
+    n = max(0, (t - 1) // block_len)
+    if cap is not None:
+        n = min(n, int(cap))
+    out: List[int] = []
+    sig = _SIG_ROOT
+    for i in range(n):
+        key = tuple(int(x) for x in tokens0[i * block_len:
+                                            (i + 1) * block_len])
+        sig = _sig_extend(sig, key)
+        out.append(sig)
+    return out
+
 
 class _Node:
-    __slots__ = ("key", "block", "children", "parent", "last_used")
+    __slots__ = ("key", "block", "children", "parent", "last_used", "sig")
 
     def __init__(self, key: Optional[Tuple[int, ...]], block: Optional[int],
-                 parent: Optional["_Node"], last_used: int):
+                 parent: Optional["_Node"], last_used: int,
+                 sig: int = _SIG_ROOT):
         self.key = key
         self.block = block
         self.children: Dict[Tuple[int, ...], "_Node"] = {}
         self.parent = parent
         self.last_used = last_used
+        self.sig = sig
 
 
 class RadixCache:
@@ -78,6 +121,13 @@ class RadixCache:
         #: still allocated.  Reassignable live (the engine wires the
         #: host-tier demotion hook here).
         self.on_evict = on_evict
+        #: optional router summary observer: ``on_insert(sig)`` /
+        #: ``on_evict(sig)`` fire synchronously under the trie lock on
+        #: every node add/drop, so the summary can never claim a chain
+        #: the trie just evicted (the router-staleness hazard).  Wire it
+        #: with :meth:`attach_summary`; independent of the block-level
+        #: ``on_evict`` demotion funnel above.
+        self.summary = None
         self._lock = threading.Lock()
         self._root = _Node(None, None, None, 0)
         self._clock = 0
@@ -136,12 +186,15 @@ class RadixCache:
                 key = self._block_key(tokens0, i)
                 child = node.children.get(key)
                 if child is None:
-                    child = _Node(key, int(blk), node, now)
+                    child = _Node(key, int(blk), node, now,
+                                  sig=_sig_extend(node.sig, key))
                     node.children[key] = child
                     self.pool.retain([int(blk)])
                     self.nodes += 1
                     self.inserted_blocks += 1
                     added += 1
+                    if self.summary is not None:
+                        self.summary.on_insert(child.sig)
                 else:
                     child.last_used = now
                 node = child
@@ -186,6 +239,8 @@ class RadixCache:
         self.pool.release([v.block])
         self.nodes -= 1
         self.evictions += 1
+        if self.summary is not None:
+            self.summary.on_evict(v.sig)
 
     def evict(self, n_blocks: int) -> int:
         """Free up to ``n_blocks`` pool blocks by dropping LRU leaf
@@ -202,6 +257,20 @@ class RadixCache:
                 self._evict_node(min(victims, key=lambda n: n.last_used))
                 freed += 1
         return freed
+
+    # -- router summary -------------------------------------------------- #
+    def attach_summary(self, summary) -> None:
+        """Attach a router prefix summary (``on_insert(sig)`` /
+        ``on_evict(sig)``) and replay the current trie into it — one
+        walk at attach time; every later refresh is the O(1) per-node
+        hook above, never another walk."""
+        with self._lock:
+            self.summary = summary
+            stack = list(self._root.children.values())
+            while stack:
+                n = stack.pop()
+                summary.on_insert(n.sig)
+                stack.extend(n.children.values())
 
     # -- introspection --------------------------------------------------- #
     def hit_rate(self) -> Optional[float]:

@@ -662,6 +662,8 @@ class LMServingEngine:
                 self.pool.ks = jax.device_put(self.pool.ks, _rep)
                 self.pool.vs = jax.device_put(self.pool.vs, _rep)
         self.radix = RadixCache(self.pool) if enable_prefix_cache else None
+        #: router-published prefix summary (see attach_radix_summary)
+        self.radix_summary = None
         self.kvtier = kvtier
         if self.kvtier is not None and self.radix is not None:
             # THE demote hook: radix-tail eviction hands each victim
@@ -1647,6 +1649,18 @@ class LMServingEngine:
             self.radix.matched_tokens += len(fresh) * B
         return out
 
+    def attach_radix_summary(self, summary) -> None:
+        """Publish this engine's radix trie to the serving router: the
+        summary mirrors the trie's prefix fingerprints (refreshed by
+        the per-node insert/evict hooks, O(1) each), so a router can
+        score this replica's cache affinity without ever touching the
+        trie.  See :mod:`bigdl_tpu.serving.router.summary`."""
+        if self.radix is None:
+            raise ValueError(
+                "attach_radix_summary requires enable_prefix_cache=True")
+        self.radix.attach_summary(summary)
+        self.radix_summary = summary
+
     def hibernate(self, stream: LMStream, *,
                   timeout: Optional[float] = 30.0) -> bool:
         """Swap an idle stream out of its decode slot: its written KV
@@ -2385,6 +2399,8 @@ class LMServingEngine:
             "kvcache": self.kvcache_stats(),
             "kvtier": (self.kvtier.stats()
                        if self.kvtier is not None else None),
+            "radix_summary": (self.radix_summary.stats()
+                              if self.radix_summary is not None else None),
             "hibernated": hibernated,
             "hibernations": self.hibernations,
             "resumes": self.resumes,

@@ -1,0 +1,27 @@
+"""bigdl_tpu.serving.router — cache-aware replica dispatch.
+
+Prefix-affinity routing over per-replica radix summaries, session
+stickiness that composes with kvtier hibernation, and the routed LM
+replica set that inherits the resilience breaker core.  This is the
+control-plane layer the multi-host pool stands on: the router never
+reads a remote trie, only its published fingerprint summary.
+
+Quickstart::
+
+    from bigdl_tpu.serving.router import LMReplicaSet, RadixRouter
+
+    rset = LMReplicaSet(model, n_replicas=3,
+                        router=RadixRouter(affinity_weight=0.7),
+                        slots=8, max_new_tokens=32)
+    s = rset.submit(prompt, session_id="chat-42")
+    for tok in s.tokens():
+        ...
+"""
+from bigdl_tpu.serving.router.replicaset import (LMReplicaSet,
+                                                 RoutedLMStream)
+from bigdl_tpu.serving.router.router import RadixRouter
+from bigdl_tpu.serving.router.sessions import SessionTable
+from bigdl_tpu.serving.router.summary import RadixSummary
+
+__all__ = ["LMReplicaSet", "RoutedLMStream", "RadixRouter",
+           "SessionTable", "RadixSummary"]

@@ -33,6 +33,7 @@ fi
 if [ "${FAULTS_GATE:-1}" = "1" ]; then
   python -m pytest tests/test_resilience.py tests/test_traffic.py \
     tests/test_kvcache.py tests/test_spec_decode.py tests/test_disagg.py \
+    tests/test_router.py \
     -q -m faults || exit 1
 fi
 

@@ -287,6 +287,63 @@ def _kvtier_problems(doc) -> list:
     return probs
 
 
+def _router_problems(doc) -> list:
+    """BENCH_ROUTER.json extras: routing is only evidence when it (a)
+    never changed an output — agreement must be exactly 1.0 on every
+    stage — and (b) actually beat the radix-blind baseline on set-level
+    prefix hit rate.  The chaos stage must show zero accepted-request
+    loss: a replica died mid-trace and every stream still finished,
+    re-routed, bit-exact."""
+    probs = []
+    if doc.get("error"):
+        return probs
+    rows = {r.get("stage"): r for r in doc.get("rows", [])
+            if isinstance(r, dict)}
+    for i, r in enumerate(doc.get("rows", [])):
+        if isinstance(r, dict) and "stage" not in r:
+            probs.append("router row %d lacks a 'stage' key" % i)
+    if doc.get("complete") is not True:
+        return probs
+    for stage in ("blind", "routed", "chaos"):
+        r = rows.get(stage)
+        if not isinstance(r, dict) or r.get("agreement") != 1.0:
+            probs.append("complete router artifact: %s agreement must "
+                         "be exactly 1.0, got %r"
+                         % (stage, (r or {}).get("agreement")))
+    blind, routed = rows.get("blind") or {}, rows.get("routed") or {}
+    bh, rh = blind.get("prefix_hit_rate"), routed.get("prefix_hit_rate")
+    if not (isinstance(bh, (int, float)) and isinstance(rh, (int, float))
+            and rh > bh):
+        probs.append("complete router artifact: routed prefix_hit_rate "
+                     "must be strictly above blind, got routed=%r "
+                     "blind=%r" % (rh, bh))
+    chaos = rows.get("chaos") or {}
+    if chaos.get("accepted_loss") != 0:
+        probs.append("complete router artifact: chaos accepted_loss "
+                     "must be exactly 0, got %r"
+                     % (chaos.get("accepted_loss"),))
+    summ = doc.get("summary")
+    if not isinstance(summ, dict):
+        probs.append("complete router artifact lacks a summary")
+        return probs
+    if summ.get("agreement") != 1.0:
+        probs.append("complete router artifact: summary.agreement must "
+                     "be exactly 1.0, got %r" % (summ.get("agreement"),))
+    if summ.get("chaos_zero_accepted_loss") is not True:
+        probs.append("complete router artifact: "
+                     "summary.chaos_zero_accepted_loss must be true, "
+                     "got %r" % (summ.get("chaos_zero_accepted_loss"),))
+    for key in ("ttft_p50_ms", "ttft_p99_ms"):
+        v = summ.get(key)
+        if not (isinstance(v, dict)
+                and isinstance(v.get("blind"), (int, float))
+                and isinstance(v.get("routed"), (int, float))):
+            probs.append("complete router artifact: summary.%s must "
+                         "report numeric blind+routed arms, got %r"
+                         % (key, v))
+    return probs
+
+
 def _memprofile_problems(doc) -> list:
     """PROFILE_MEM.json extras: the memory-ledger profile is only
     evidence when the attribution actually happened — a complete doc
@@ -371,6 +428,8 @@ def _problems(doc, name: str = "") -> list:
             probs.extend(_qcompute_problems(doc))
         if name == "BENCH_KVTIER.json":
             probs.extend(_kvtier_problems(doc))
+        if name == "BENCH_ROUTER.json":
+            probs.extend(_router_problems(doc))
         if name == "PROFILE_MEM.json":
             probs.extend(_memprofile_problems(doc))
         return probs
