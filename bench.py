@@ -2718,6 +2718,323 @@ def _serve_lm_router_bench(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --serve-lm --deadline: request lifecycle -> BENCH_DEADLINE.json
+# ---------------------------------------------------------------------------
+
+def _serve_lm_deadline_bench(argv) -> int:
+    """Request-lifecycle benchmark -> BENCH_DEADLINE.json (resumable).
+
+    One seeded open-loop trace (Poisson arrivals; per-request deadline
+    budgets and client-disconnect instants drawn from the loadgen's
+    lifecycle menus) replayed through three LMReplicaSet arms:
+
+    - ``lifecycle``: honor_lifecycle=True — expired requests shed
+      pre-admission as typed ServingDeadlineExceeded, mid-stream
+      expiry/cancel finishes the stream with a typed truncation and
+      frees the slot the same scheduler round.
+    - ``baseline``: honor_lifecycle=False — the ignore-everything
+      control: the engines RECORD deadline/cancel events (and count
+      every decode step spent on a dead-but-seated stream as wasted)
+      but never shed or free early.
+    - ``chaos``: lifecycle + hedged dispatch + a serving.cancel
+      disconnect storm + a replica killed mid-trace (i.e. mid-hedge
+      when the race is on).  Gate: ZERO accepted-request loss — every
+      accepted stream ends completed, typed-truncated, or typed-shed.
+
+    AGREEMENT artifact: completed streams must equal the single-engine
+    reference (same prompt, seed, temperature) exactly; truncated
+    streams must be an exact PREFIX of it — a deadline or disconnect
+    may cost tokens, never correctness.  Headline gates: agreement
+    exactly 1.0, chaos zero loss, and the lifecycle arm strictly
+    beating the baseline on BOTH wasted decode steps and goodput
+    under SLO."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --serve-lm --deadline")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--rate", type=float, default=float(
+        os.environ.get("BIGDL_TPU_DEADLINE_RATE", "12.0")))
+    ap.add_argument("--duration", type=float, default=float(
+        os.environ.get("BIGDL_TPU_DEADLINE_DURATION", "3.0")))
+    ap.add_argument("--seed", type=int, default=5)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=4)
+    ap.add_argument("--cache-len", type=int, default=96)
+    ap.add_argument("--block-len", type=int, default=16)
+    args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_DEADLINE.json")
+    if args.replicas < 2:
+        ap.error("need >= 2 replicas (chaos kills one mid-trace)")
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import threading
+
+    import jax
+    import numpy as np
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.resilience import faults
+    from bigdl_tpu.resilience.errors import ServingDeadlineExceeded
+    from bigdl_tpu.serving import HedgePolicy, LMServingEngine
+    from bigdl_tpu.serving.router import LMReplicaSet
+    from bigdl_tpu.traffic.loadgen import TraceLoadGenerator
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    #: disconnect storm: every live stream crosses serving.cancel once
+    #: per scheduler round; 2% of crossings hang up the client
+    storm_spec = "serving.cancel:transient:p=0.02"
+    gen = TraceLoadGenerator(
+        kind="poisson", rate_rps=args.rate, duration_s=args.duration,
+        seed=args.seed, vocab=256, prompt_lens=(8, 16, 24),
+        max_news=(12, 20, 28),
+        deadline_menu=(0.9, 2.5, None), deadline_fraction=1.0,
+        cancel_after_menu=(0.06, 0.15, None, None), cancel_fraction=1.0)
+    #: chaos-arm hedge policy: median-wait trigger so queue-delayed
+    #: requests actually hedge on this short trace (a p99 trigger needs
+    #: a longer window than the storm stage runs)
+    hedge_cfg = {"trigger_quantile": 0.5, "window": 128,
+                 "min_observations": 8, "max_hedge_fraction": 0.3,
+                 "min_trigger_s": 0.002}
+    config = {"model": "transformer_lm", "vocab": 256, "hidden": 128,
+              "heads": 4, "layers": 2, "max_len": args.cache_len,
+              "pos": "rope", "layout": "paged",
+              "slots": args.slots, "cache_len": args.cache_len,
+              "block_len": args.block_len, "replicas": args.replicas,
+              "storm": storm_spec, "hedge": hedge_cfg,
+              "trace": gen.config()}
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "lm_serving_deadline", "platform": platform,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+    model = TransformerLM(
+        vocab_size=config["vocab"], hidden_size=config["hidden"],
+        n_head=config["heads"], n_layers=config["layers"],
+        max_len=args.cache_len, pos_encoding="rope").build(seed=7)
+    trace = gen.trace()
+    eng_kw = dict(slots=args.slots, cache_len=args.cache_len,
+                  block_len=args.block_len,
+                  max_new_tokens=max(gen.max_news),
+                  prefill_buckets=(8, 16, 32), temperature=0.7,
+                  max_queue=max(len(trace) * 2, 128))
+    TEMP, TIMEOUT = 0.7, 600.0
+
+    # -- single-engine reference: one exact answer per arrival -------- #
+    refs = [None] * len(trace)
+    ref_eng = LMServingEngine(model, **eng_kw)
+    try:
+        ref_eng.warmup()
+        for a in trace:
+            refs[a.index] = ref_eng.generate(
+                a.prompt, max_new_tokens=a.max_new, temperature=TEMP,
+                rng=1000 + a.index, timeout=TIMEOUT)
+    finally:
+        ref_eng.close()
+
+    def _run_arm(name, *, honor, hedge=None, storm=False,
+                 kill_at_s=None):
+        """Replay the trace through one arm; returns the stage row."""
+        rset = LMReplicaSet(model, args.replicas, hedge=hedge,
+                            honor_lifecycle=honor, name=name, **eng_kw)
+        timers: list = []
+        recs: list = []
+        try:
+            rset.warmup()
+            if storm:
+                # arming publishes the spec in the environment first
+                # (the injector refuses silent activation, and a `ps e`
+                # shows the storm) — same pattern as ChaosReplayer
+                os.environ[faults.ENV_SPEC] = storm_spec
+                faults.install(faults.FaultInjector(
+                    faults.parse_spec(storm_spec), seed=13))
+            if kill_at_s is not None:
+                t = threading.Timer(
+                    kill_at_s, lambda: rset.kill_replica(f"{name}-r1"))
+                t.daemon = True
+                t.start()
+                timers.append(t)
+
+            def _submit(a):
+                st = rset.submit(a.prompt, max_new_tokens=a.max_new,
+                                 temperature=TEMP, rng=1000 + a.index,
+                                 deadline_s=a.deadline_s,
+                                 hedgeable=hedge is not None)
+                rec = {"a": a, "st": st, "abandoned": False}
+                if a.cancel_after_s is not None:
+                    def _hangup(rec=rec, st=st):
+                        # True only if the client left a LIVE stream —
+                        # a post-completion hangup watched it all
+                        rec["abandoned"] = bool(st.cancel())
+                    ht = threading.Timer(a.cancel_after_s, _hangup)
+                    ht.daemon = True
+                    ht.start()
+                    timers.append(ht)
+                recs.append(rec)
+                return st
+
+            t0 = time.perf_counter()
+            report = gen.run(_submit, trace=trace)
+            completed = truncated = typed_shed = losses = 0
+            mism = good = 0
+            for rec in recs:
+                a, st = rec["a"], rec["st"]
+                try:
+                    st.result(timeout=TIMEOUT)
+                    err = None
+                except ServingDeadlineExceeded as e:
+                    err = e
+                except Exception as e:  # noqa: BLE001 — loss below
+                    err = e
+                ref_gen = refs[a.index][len(a.prompt):]
+                if err is None and st.truncation is None:
+                    completed += 1
+                    if not np.array_equal(st.generated, ref_gen):
+                        mism += 1
+                    else:
+                        lat = st.finished_at - st.submitted_at
+                        if (not rec["abandoned"]
+                                and (a.deadline_s is None
+                                     or lat <= a.deadline_s)):
+                            good += 1
+                elif err is None:
+                    truncated += 1
+                    g = st.generated
+                    if not np.array_equal(g, ref_gen[:len(g)]):
+                        mism += 1
+                elif isinstance(err, ServingDeadlineExceeded):
+                    typed_shed += 1
+                else:
+                    losses += 1
+            wall = time.perf_counter() - t0
+            checked = completed + truncated
+            lc = rset.lifecycle_stats()
+            st_all = rset.stats()
+            row = {
+                "honor_lifecycle": bool(honor),
+                "offered": report.offered,
+                "accepted": len(report.accepted),
+                "shed_preadmission": len(report.shed),
+                "submit_errors": len(report.errors),
+                "completed": completed, "truncated": truncated,
+                "typed_shed_postadmission": typed_shed,
+                "accepted_loss": losses,
+                "agreement": (round((checked - mism) / checked, 4)
+                              if checked else None),
+                "good_requests": good,
+                "wall_s": round(wall, 3),
+                "goodput_rps": round(good / wall, 4) if wall else None,
+                "wasted_decode_steps": lc["wasted_decode_steps"],
+                "lifecycle": lc,
+            }
+            if hedge is not None:
+                row["hedge"] = st_all["hedge"]
+            if kill_at_s is not None:
+                row["killed_replica"] = f"{name}-r1"
+            if storm:
+                inj = faults.active()
+                row["storm_disconnects"] = (
+                    sum(d["fired"] for d in inj.stats().values())
+                    if inj else None)
+            return row
+        finally:
+            for t in timers:
+                t.cancel()
+            if storm:
+                faults.install(None)
+                os.environ.pop(faults.ENV_SPEC, None)
+            rset.close()
+
+    stages = {
+        "lifecycle": lambda: _run_arm("deadline", honor=True),
+        "baseline": lambda: _run_arm("ignore", honor=False),
+        "chaos": lambda: _run_arm(
+            "chaos", honor=True, storm=True,
+            kill_at_s=args.duration * 0.5,
+            hedge=HedgePolicy(**hedge_cfg)),
+    }
+    for name, run in stages.items():
+        if name in prev:
+            row = dict(prev[name])
+            row["reused_from_previous_run"] = True
+        else:
+            row = {"stage": name, **run()}
+        rows.append(row)
+        flush()
+
+    lifecycle = next(r for r in rows if r.get("stage") == "lifecycle")
+    baseline = next(r for r in rows if r.get("stage") == "baseline")
+    chaos = next(r for r in rows if r.get("stage") == "chaos")
+    problems = []
+    for r in (lifecycle, baseline, chaos):
+        if r["agreement"] != 1.0:
+            problems.append(
+                "stage %s agreement %r != 1.0 — lifecycle handling "
+                "changed surviving tokens" % (r["stage"], r["agreement"]))
+        if r["accepted_loss"] != 0:
+            problems.append("stage %s lost %d accepted request(s)"
+                            % (r["stage"], r["accepted_loss"]))
+    if lifecycle["truncated"] + lifecycle["typed_shed_postadmission"] \
+            + lifecycle["shed_preadmission"] == 0:
+        problems.append("lifecycle stage shed/truncated nothing — the "
+                        "trace never exercised the machinery")
+    if lifecycle["wasted_decode_steps"] >= baseline["wasted_decode_steps"]:
+        problems.append(
+            "lifecycle wasted_decode_steps %d not strictly below "
+            "baseline %d — honoring lifecycle bought no decode back"
+            % (lifecycle["wasted_decode_steps"],
+               baseline["wasted_decode_steps"]))
+    if not (lifecycle["goodput_rps"] and baseline["goodput_rps"]
+            and lifecycle["goodput_rps"] > baseline["goodput_rps"]):
+        problems.append(
+            "lifecycle goodput %r rps not strictly above baseline %r"
+            % (lifecycle["goodput_rps"], baseline["goodput_rps"]))
+    if problems:
+        for p in problems:
+            print("bench: DEADLINE GATE: " + p + " — artifact left "
+                  "incomplete", file=sys.stderr)
+        flush()
+        return 1
+    result["summary"] = {
+        "agreement": 1.0,
+        "wasted_decode_steps": {
+            "lifecycle": lifecycle["wasted_decode_steps"],
+            "baseline": baseline["wasted_decode_steps"]},
+        "goodput_rps": {"lifecycle": lifecycle["goodput_rps"],
+                        "baseline": baseline["goodput_rps"]},
+        "goodput_gain": round(
+            lifecycle["goodput_rps"] / baseline["goodput_rps"], 3),
+        "chaos_zero_accepted_loss": chaos["accepted_loss"] == 0,
+        "chaos_truncated": chaos["truncated"],
+        "hedges_fired": (chaos.get("hedge") or {}).get("hedges_fired"),
+        "hedges_won": (chaos.get("hedge") or {}).get("hedges_won"),
+    }
+    result["complete"] = True
+    flush()
+    print(json.dumps({
+        "metric": "lm_serving_deadline_goodput_gain",
+        "value": result["summary"]["goodput_gain"],
+        "unit": "x_vs_ignore_baseline", "platform": platform,
+        **{k: v for k, v in result["summary"].items()
+           if k != "goodput_gain"}}), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --serve-lm --disagg: disaggregated prefill/decode -> BENCH_DISAGG.json
 # ---------------------------------------------------------------------------
 
@@ -3616,6 +3933,10 @@ if __name__ == "__main__":
         sys.exit(_serve_lm_router_bench(
             [a for a in sys.argv[1:]
              if a not in ("--serve-lm", "--router")]))
+    if "--serve-lm" in sys.argv and "--deadline" in sys.argv:
+        sys.exit(_serve_lm_deadline_bench(
+            [a for a in sys.argv[1:]
+             if a not in ("--serve-lm", "--deadline")]))
     if "--serve-lm" in sys.argv and "--kvtier" in sys.argv:
         sys.exit(_serve_lm_kvtier_bench(
             [a for a in sys.argv[1:]

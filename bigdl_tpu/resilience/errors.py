@@ -37,6 +37,17 @@ class ServingOverloaded(TransientBackendError):
     the accounting the SLO controller and goodput metric depend on."""
 
 
+class ServingDeadlineExceeded(ServingOverloaded):
+    """A request's wall-clock budget (``deadline_s``, minted at enqueue)
+    expired before the server started useful work on it, so admission
+    control shed it instead of prefilling an answer nobody is waiting
+    for.  Subclassing :class:`ServingOverloaded` keeps every existing
+    shed path honest for free: the SLO ladder, loadgen shed accounting,
+    and ``serving/rejected_total`` all treat a blown deadline exactly
+    like a backpressure rejection — the request was *not* lost, it was
+    refused with a typed receipt."""
+
+
 #: Substrings that mark a retryable wobble (same set the bench.py
 #: supervisor restarts a sweep on).  RESOURCE_EXHAUSTED is here on
 #: purpose: for transfers the remedy is the chunk-size downshift that

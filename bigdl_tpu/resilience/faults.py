@@ -18,14 +18,21 @@ Spec grammar (``;``-separated specs)::
 
     site   hook-point name: transfer.chunk | engine.init |
            serving.dispatch | serving.enqueue | serving.verify |
-           serving.migrate
+           serving.migrate | serving.cancel
            (more may be added freely; a transient at serving.verify
            demotes the speculating slots to plain decode instead of
            killing their streams — see lm_engine._step_spec; a
            transient at serving.migrate retries the KV-chain export
            via with_backoff, backend_lost makes the decode replica
            re-prefill the migrated prompt — zero accepted loss either
-           way, see serving/disagg/coordinator.py)
+           way, see serving/disagg/coordinator.py; serving.cancel is
+           the client-disconnect site — it is crossed once per live
+           stream per scheduler round, and ANY injected fault there is
+           converted into a cooperative ``stream.cancel()``, i.e. the
+           client walked away mid-stream.  The stream finishes with a
+           typed truncation, never an error: a disconnect storm must
+           cost wasted decode, not correctness — see
+           lm_engine._lifecycle_round)
     kind   transient     raise TransientBackendError
            backend_lost  raise BackendLostError
            die           alias of backend_lost (reads better for

@@ -23,6 +23,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Optional, Sequence
 
@@ -54,6 +55,117 @@ class _Replica:
         self.slot = slot  # MeshSlice under placement, else None
 
 
+class HedgePolicy:
+    """Spark speculative execution, reborn for serving dispatch.
+
+    BigDL's Spark lineage re-launched straggler tasks on another
+    executor and took the first finisher; here the unit is a dispatched
+    request: when one has waited longer than a **windowed-p99-based
+    trigger** without progress, the set speculatively re-dispatches it
+    to the next-best replica, the first completion wins, and the loser
+    is cancelled through the cooperative-cancel path.
+
+    Two guardrails keep hedging from amplifying an overload:
+
+    - the trigger is *evidence-based*: no hedge fires until at least
+      ``min_observations`` completed waits sit in the rolling window,
+      and the trigger is the window's ``trigger_quantile`` (default
+      p99) — a straggler is defined by the traffic itself, not a
+      hard-coded timeout;
+    - a **hedge budget**: fired hedges may never exceed
+      ``max_hedge_fraction`` of total dispatches (Spark's
+      ``speculation.quantile`` spirit), so the extra load is bounded
+      at N% by construction.
+
+    Thread-safe; shared by every dispatch thread of one replica set.
+    Counters publish under ``serving/lifecycle/hedges_*``.
+    """
+
+    def __init__(self, *, trigger_quantile: float = 0.99,
+                 window: int = 256, min_observations: int = 16,
+                 max_hedge_fraction: float = 0.05,
+                 min_trigger_s: float = 0.0):
+        if not 0.0 < trigger_quantile <= 1.0:
+            raise ValueError("trigger_quantile must be in (0, 1]")
+        if not 0.0 < max_hedge_fraction <= 1.0:
+            raise ValueError("max_hedge_fraction must be in (0, 1]")
+        self.trigger_quantile = float(trigger_quantile)
+        self.window = int(window)
+        self.min_observations = int(min_observations)
+        self.max_hedge_fraction = float(max_hedge_fraction)
+        self.min_trigger_s = float(min_trigger_s)
+        self._lock = threading.Lock()
+        self._waits: deque = deque(maxlen=self.window)
+        self.dispatches = 0
+        self.hedges_fired = 0
+        self.hedges_won = 0      # the hedge finished first
+        self.hedges_lost = 0     # the primary finished first
+        from bigdl_tpu.obs import get_registry
+        reg = get_registry()
+        self._c_fired = reg.counter("serving/lifecycle/hedges_fired")
+        self._c_won = reg.counter("serving/lifecycle/hedges_won")
+        self._c_lost = reg.counter("serving/lifecycle/hedges_lost")
+
+    def note_dispatch(self) -> None:
+        with self._lock:
+            self.dispatches += 1
+
+    def observe(self, wait_s: float) -> None:
+        """Record one completed request's wait (queue-wait / time to
+        first progress) into the trigger window."""
+        with self._lock:
+            self._waits.append(float(wait_s))
+
+    def trigger_s(self) -> Optional[float]:
+        """The current hedge trigger (windowed quantile), or None while
+        the window holds too little evidence to define a straggler."""
+        with self._lock:
+            n = len(self._waits)
+            if n < self.min_observations:
+                return None
+            s = sorted(self._waits)
+            q = s[min(n - 1, int(self.trigger_quantile * (n - 1)))]
+            return max(q, self.min_trigger_s)
+
+    def should_hedge(self, waited_s: float) -> bool:
+        """True when ``waited_s`` marks a straggler AND the hedge
+        budget (≤ ``max_hedge_fraction`` of dispatches) has room."""
+        trig = self.trigger_s()
+        if trig is None or waited_s < trig:
+            return False
+        with self._lock:
+            return (self.hedges_fired + 1) <= (
+                self.max_hedge_fraction * max(1, self.dispatches))
+
+    def note_fired(self) -> None:
+        with self._lock:
+            self.hedges_fired += 1
+        self._c_fired.add(1)
+
+    def note_outcome(self, hedge_won: bool) -> None:
+        with self._lock:
+            if hedge_won:
+                self.hedges_won += 1
+            else:
+                self.hedges_lost += 1
+        (self._c_won if hedge_won else self._c_lost).add(1)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "trigger_quantile": self.trigger_quantile,
+                "max_hedge_fraction": self.max_hedge_fraction,
+                "window_n": len(self._waits),
+                "dispatches": self.dispatches,
+                "hedges_fired": self.hedges_fired,
+                "hedges_won": self.hedges_won,
+                "hedges_lost": self.hedges_lost,
+            }
+
+    def snapshot_trigger(self) -> Optional[float]:
+        return self.trigger_s()
+
+
 class ReplicaSetCore:
     """The engine-agnostic half of a replica set: per-replica circuit
     breakers, the half-open probe protocol, and replica selection with
@@ -79,13 +191,17 @@ class ReplicaSetCore:
                    cooldown_s: float = 5.0,
                    max_redispatch: int = 1,
                    clock=time.monotonic,
-                   dispatch_policy=None) -> None:
+                   dispatch_policy=None,
+                   hedge_policy: Optional[HedgePolicy] = None) -> None:
         from bigdl_tpu.obs import get_registry
         self.failure_threshold = int(failure_threshold)
         self.cooldown_s = float(cooldown_s)
         self.max_redispatch = int(max_redispatch)
         self._clock = clock
         self.dispatch_policy = dispatch_policy
+        # opt-in speculative re-dispatch (Spark speculative execution):
+        # None disables hedging entirely
+        self.hedge_policy = hedge_policy
         self._lock = threading.Lock()
         self._registry = get_registry()
         self._replicas: list = []

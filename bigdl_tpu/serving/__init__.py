@@ -20,6 +20,8 @@ Quickstart::
         fut = eng.submit(another_batch)   # async
         print(eng.stats()["compile_cache"]["hit_rate"])
 """
+from bigdl_tpu.resilience.errors import ServingDeadlineExceeded
+from bigdl_tpu.resilience.replicaset import HedgePolicy
 from bigdl_tpu.serving.batcher import (DynamicBatcher, ServingClosed,
                                        ServingOverloaded, ServingQueueFull,
                                        power_of_two_buckets)
@@ -32,6 +34,7 @@ from bigdl_tpu.serving.kvcache import (BlockPool, PoolExhausted, RadixCache,
 from bigdl_tpu.serving.kvtier import HostBlockStore
 from bigdl_tpu.serving.lm_engine import (KVHandoff, LMMetrics,
                                          LMServingEngine, LMStream,
+                                         StreamTruncation,
                                          prefill_bucket_lengths)
 from bigdl_tpu.serving.metrics import LatencyHistogram, ServingMetrics
 from bigdl_tpu.serving.router import (LMReplicaSet, RadixRouter,
@@ -46,8 +49,10 @@ from bigdl_tpu.serving.spec import DraftModel, SpecConfig, SpecMetrics
 __all__ = [
     "ServingEngine", "DynamicBatcher", "CompileCache", "HostStager",
     "ServingMetrics", "LatencyHistogram", "ServingQueueFull",
-    "ServingOverloaded", "ServingClosed", "power_of_two_buckets",
-    "LMServingEngine", "LMStream", "LMMetrics", "prefill_bucket_lengths",
+    "ServingOverloaded", "ServingClosed", "ServingDeadlineExceeded",
+    "power_of_two_buckets",
+    "LMServingEngine", "LMStream", "LMMetrics", "StreamTruncation",
+    "HedgePolicy", "prefill_bucket_lengths",
     "DisaggCoordinator", "KVHandoff",
     "BlockPool", "RadixCache", "PoolExhausted", "RequestExceedsPool",
     "HostBlockStore",

@@ -27,7 +27,9 @@ import numpy as np
 
 from bigdl_tpu.obs.tracer import (clear_request_context, get_tracer,
                                   mint_request_id, set_request_context)
-from bigdl_tpu.resilience.errors import ServingOverloaded, TransientBackendError
+from bigdl_tpu.resilience.errors import (ServingDeadlineExceeded,
+                                         ServingOverloaded,
+                                         TransientBackendError)
 
 _tracer = get_tracer()
 
@@ -98,14 +100,19 @@ def _tree_concat(parts: list):
 
 
 class _Request:
-    __slots__ = ("x", "n", "future", "t_enqueue", "rid")
+    __slots__ = ("x", "n", "future", "t_enqueue", "rid", "deadline_at")
 
-    def __init__(self, x, n: int, future: Future, rid: str):
+    def __init__(self, x, n: int, future: Future, rid: str,
+                 deadline_at: Optional[float] = None):
         self.x = x
         self.n = n
         self.future = future
         self.t_enqueue = time.perf_counter()
         self.rid = rid
+        # absolute monotonic deadline, minted at enqueue (None = no
+        # budget): checked when the batch is ASSEMBLED, so an expired
+        # request is shed before it costs a device dispatch
+        self.deadline_at = deadline_at
 
 
 def _safe_resolve(future: Future, *, result=None, exc=None) -> None:
@@ -192,13 +199,27 @@ class DynamicBatcher:
         raise ValueError(f"no bucket holds {n} rows "
                          f"(largest is {self.buckets[-1]})")
 
-    def submit(self, x, n: Optional[int] = None) -> Future:
+    def submit(self, x, n: Optional[int] = None,
+               deadline_s: Optional[float] = None) -> Future:
         """Enqueue a request of ``n`` examples (leading dim of ``x``);
         raises ServingQueueFull (a ServingOverloaded) when the bounded
-        queue is full."""
+        queue is full.
+
+        ``deadline_s`` is an optional wall-clock budget minted here:
+        a request still queued when it expires is shed at batch
+        assembly (before any device work) with the typed
+        :class:`~bigdl_tpu.resilience.errors.ServingDeadlineExceeded`.
+        Cancelling the returned future before dispatch is likewise
+        honored at assembly: the request never reaches the device."""
         x = np.asarray(x)
         if n is None:
             n = int(x.shape[0]) if x.ndim else 1
+        if deadline_s is not None and float(deadline_s) <= 0.0:
+            if self._metrics is not None:
+                self._metrics.record_reject()
+            count_rejection()
+            raise ServingDeadlineExceeded(
+                f"deadline_s={deadline_s} already expired at enqueue")
         # resilience hook: chaos exercises the admission path here.  An
         # injected transient is surfaced as the SAME typed shed a real
         # overload produces, so clients and the loadgen account for it
@@ -224,7 +245,10 @@ class DynamicBatcher:
                 raise ServingQueueFull(
                     f"request queue full ({self._max_queue} pending); "
                     "retry later or raise max_queue")
-            self._queue.append(_Request(x, n, fut, rid))
+            self._queue.append(_Request(
+                x, n, fut, rid,
+                deadline_at=(time.monotonic() + float(deadline_s)
+                             if deadline_s is not None else None)))
             depth = len(self._queue)
             self._cv.notify()
         fut.request_id = rid  # clients correlate responses with traces
@@ -296,15 +320,47 @@ class DynamicBatcher:
             except Exception:
                 pass  # a crashed-and-restarted guard already resolved it
 
+    def _shed_dead(self, r: _Request) -> bool:
+        """Lifecycle gate at batch assembly: a cancelled future or a
+        blown deadline never reaches the device.  Returns True when
+        the request was consumed (shed) here."""
+        if r.future.cancelled():
+            from bigdl_tpu.obs import get_registry
+            get_registry().counter("serving/lifecycle/cancelled").add(1)
+            if _tracer.sampled(r.rid):
+                _tracer.instant("serve/lifecycle_shed", cat="serve",
+                                request_id=r.rid, reason="cancelled")
+            return True
+        if r.deadline_at is not None and time.monotonic() >= r.deadline_at:
+            if self._metrics is not None:
+                self._metrics.record_reject()
+            count_rejection()
+            from bigdl_tpu.obs import get_registry
+            get_registry().counter(
+                "serving/lifecycle/expired_preadmission").add(1)
+            _safe_resolve(r.future, exc=ServingDeadlineExceeded(
+                "deadline expired while queued; request shed before "
+                "dispatch"))
+            if _tracer.sampled(r.rid):
+                _tracer.instant("serve/lifecycle_shed", cat="serve",
+                                request_id=r.rid, reason="deadline")
+            return True
+        return False
+
     def _take_batch(self) -> Optional[list]:
         """Block for the first request, then gather until the batch is
-        full or the oldest request's wait budget expires."""
+        full or the oldest request's wait budget expires.  Requests
+        whose future was cancelled or whose deadline expired while
+        queued are shed here, before any device work."""
         with self._cv:
-            while not self._queue:
-                if self._stop:
-                    return None
-                self._cv.wait(timeout=0.05)
-            first = self._queue.popleft()
+            while True:
+                while not self._queue:
+                    if self._stop:
+                        return None
+                    self._cv.wait(timeout=0.05)
+                first = self._queue.popleft()
+                if not self._shed_dead(first):
+                    break
             if first.n >= self._max_batch:
                 return [first]  # full (or oversized: served alone, chunked)
             batch, total = [first], first.n
@@ -314,8 +370,11 @@ class DynamicBatcher:
                     nxt = self._queue[0]
                     if total + nxt.n > self._max_batch:
                         break  # never split a request across batches
-                    batch.append(self._queue.popleft())
-                    total += nxt.n
+                    r = self._queue.popleft()
+                    if self._shed_dead(r):
+                        continue
+                    batch.append(r)
+                    total += r.n
                     continue
                 remaining = deadline - time.perf_counter()
                 if remaining <= 0 or self._stop:

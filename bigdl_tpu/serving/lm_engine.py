@@ -65,7 +65,9 @@ import numpy as np
 from bigdl_tpu.obs import get_registry, get_tracer
 from bigdl_tpu.obs.registry import FnGauge, Histogram
 from bigdl_tpu.obs.tracer import mint_request_id
-from bigdl_tpu.resilience.errors import (ServingOverloaded,
+from bigdl_tpu.resilience.errors import (BackendLostError,
+                                         ServingDeadlineExceeded,
+                                         ServingOverloaded,
                                          TransientBackendError)
 from bigdl_tpu.serving.batcher import (ServingClosed, ServingQueueFull,
                                        count_rejection)
@@ -93,6 +95,28 @@ def prefill_bucket_lengths(max_len: int, min_bucket: int = 8) -> tuple:
 
 
 # ---------------------------------------------------------------------- #
+class StreamTruncation:
+    """Typed marker for a stream the lifecycle layer ended early.
+
+    Attached as ``LMStream.truncation`` when a mid-stream deadline
+    expiry or a cooperative cancel finishes the stream: the tokens
+    already emitted stay valid (and bit-exact), the stream completes
+    WITHOUT an error, and the marker records why and where it stopped.
+    ``reason`` is ``"deadline"`` or ``"cancelled"``."""
+
+    __slots__ = ("reason", "at_tokens", "deadline_s")
+
+    def __init__(self, reason: str, at_tokens: int,
+                 deadline_s: Optional[float] = None):
+        self.reason = str(reason)
+        self.at_tokens = int(at_tokens)  # generated length at truncation
+        self.deadline_s = deadline_s     # original budget, if any
+
+    def __repr__(self):
+        return (f"StreamTruncation(reason={self.reason!r}, "
+                f"at_tokens={self.at_tokens})")
+
+
 class LMStream:
     """Per-request handle: tokens stream in as the engine decodes them.
 
@@ -100,10 +124,18 @@ class LMStream:
     ``result()`` blocks for the full sequence (prompt + generated).
     Timing marks (submit / first token / finish) feed the TTFT and
     inter-token-latency metrics and are readable per request.
+
+    Lifecycle: an optional wall-clock budget (``deadline_s``, armed at
+    enqueue) and a public :meth:`cancel`.  Both are COOPERATIVE — the
+    engine honors them at its next scheduler round, recycling the
+    decode slot and KV blocks and finishing the stream with a typed
+    :class:`StreamTruncation` marker (already-emitted tokens stay
+    valid; ``result()`` returns them without raising).
     """
 
     def __init__(self, prompt_1b: np.ndarray, max_new: int,
-                 request_id: Optional[str] = None):
+                 request_id: Optional[str] = None,
+                 deadline_s: Optional[float] = None):
         self.prompt = prompt_1b
         self.max_new = int(max_new)
         self.request_id = request_id    # trace/flight correlation handle
@@ -114,6 +146,57 @@ class LMStream:
         self.submitted_at = time.perf_counter()
         self.first_token_at: Optional[float] = None
         self.finished_at: Optional[float] = None
+        # --- lifecycle ---------------------------------------------- #
+        self.deadline_s = (float(deadline_s)
+                           if deadline_s is not None else None)
+        # absolute wall-clock deadline, minted at construction so the
+        # remaining budget (not a reset one) rides every re-dispatch,
+        # KV handoff, and hibernate/resume hop
+        self.deadline_at = ((time.monotonic() + self.deadline_s)
+                            if self.deadline_s is not None else None)
+        self.truncation: Optional[StreamTruncation] = None
+        self._cancel_requested = False
+        self._cancel_at_gen = 0         # generated length when cancelled
+        self._wake_cb = None            # engine nudge, set at enqueue
+
+    # lifecycle ---------------------------------------------------------- #
+    def cancel(self) -> bool:
+        """Request cooperative cancellation (client disconnected /
+        stopped caring).  Returns True if the request was still live;
+        the engine honors it at the next scheduler round.  Idempotent
+        and safe from any thread."""
+        with self._cond:
+            if self._done:
+                return False
+            if not self._cancel_requested:
+                self._cancel_requested = True
+                self._cancel_at_gen = len(self._tokens)
+            cb = self._wake_cb
+        if cb is not None:
+            try:
+                cb()
+            except Exception:   # a closing engine must not fail cancel
+                pass
+        return True
+
+    @property
+    def cancel_requested(self) -> bool:
+        with self._cond:
+            return self._cancel_requested
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        """True when the wall-clock budget is spent."""
+        if self.deadline_at is None:
+            return False
+        return (now if now is not None else time.monotonic()) \
+            >= self.deadline_at
+
+    def remaining_s(self, now: Optional[float] = None) -> Optional[float]:
+        """Budget left (seconds; may be negative), or None if unbounded."""
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - (now if now is not None
+                                   else time.monotonic())
 
     # engine-side ------------------------------------------------------- #
     def _emit(self, token_1b: int) -> None:
@@ -131,6 +214,18 @@ class LMStream:
             self._error = error
             self.finished_at = time.perf_counter()
             self._cond.notify_all()
+
+    def _finish_truncated(self, reason: str) -> None:
+        """Finish early with a typed truncation marker (no error): the
+        tokens already emitted remain the valid, bit-exact prefix of
+        what the full decode would have produced."""
+        with self._cond:
+            if self._done:
+                return
+            if self.truncation is None:
+                self.truncation = StreamTruncation(
+                    reason, len(self._tokens), self.deadline_s)
+        self._finish()
 
     # client-side ------------------------------------------------------- #
     def tokens(self, timeout: Optional[float] = None):
@@ -551,6 +646,7 @@ class LMServingEngine:
                  max_prefill_chunk_tokens: Optional[int] = None,
                  migrate=None,
                  kvtier=None,
+                 honor_lifecycle: bool = True,
                  metrics: Optional[LMMetrics] = None,
                  metrics_prefix: str = "serving/lm/"):
         select_platform(platform)
@@ -910,6 +1006,23 @@ class LMServingEngine:
         self._n_active = 0
         self._closing = False
         self._abort = False
+        self._lc_nudge = False    # a cancel/deadline wants a sweep
+        # -- request lifecycle (deadlines / cooperative cancel) ---------- #
+        # honor_lifecycle=False is the bench's ignore-everything
+        # baseline: deadlines and cancels are RECORDED (so wasted
+        # decode work is measurable) but never acted on.
+        self.honor_lifecycle = bool(honor_lifecycle)
+        self._lc_lock = threading.Lock()
+        self.lifecycle = {
+            "expired_preadmission": 0,   # shed before prefill
+            "expired_midstream": 0,      # truncated while decoding
+            "cancelled": 0,              # cooperative cancels honored
+            "wasted_decode_steps": 0,    # slot-steps past cancel/deadline
+        }
+        _reg = get_registry()
+        self._lc_counters = {
+            k: _reg.counter(f"serving/lifecycle/{k}")
+            for k in self.lifecycle}
         self._worker = threading.Thread(
             target=self._run, daemon=True, name=f"lm-serve-{name}")
         self._worker.start()
@@ -1127,9 +1240,16 @@ class LMServingEngine:
                max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                eos_id: Optional[int] = None,
+               deadline_s: Optional[float] = None,
                rng=None) -> LMStream:
         """Enqueue one prompt ((t,) or (1, t), 1-based ids); returns an
-        :class:`LMStream` of its continuation."""
+        :class:`LMStream` of its continuation.
+
+        ``deadline_s`` is an optional wall-clock budget minted here, at
+        enqueue: a request still queued when it expires is shed before
+        prefill with :class:`ServingDeadlineExceeded`; a stream past it
+        mid-decode is finished with a typed truncation marker and its
+        slot/blocks recycled the same scheduler round."""
         prompt = np.asarray(prompt_ids).reshape(-1).astype(np.int32)
         t = prompt.shape[0]
         if t == 0:
@@ -1189,7 +1309,17 @@ class LMServingEngine:
                 f"admission shed (injected at serving.enqueue): {e}") from e
 
         rid = mint_request_id()
-        stream = LMStream(prompt, max_new, request_id=rid)
+        stream = LMStream(prompt, max_new, request_id=rid,
+                          deadline_s=deadline_s)
+        stream._wake_cb = self._lc_wake
+        if (self.honor_lifecycle and deadline_s is not None
+                and float(deadline_s) <= 0.0):
+            # already dead on arrival: shed synchronously, typed
+            self.metrics.record_reject()
+            count_rejection()
+            self._lc_count("expired_preadmission")
+            raise ServingDeadlineExceeded(
+                f"deadline_s={deadline_s} already expired at enqueue")
         req = _Request(stream, prompt - 1, max_new, temp, eos0,
                        first_key, step_keys, rid)
         with self._cv:
@@ -1218,6 +1348,9 @@ class LMServingEngine:
         emitted.  Adoptions outrank queued submissions (they are
         further along: TTFT is already paid) and defer under pool
         pressure exactly like admissions."""
+        # the deadline rides the handoff on the stream itself; rebind
+        # the cancel nudge so a disconnect now wakes THIS worker
+        handoff.stream._wake_cb = self._lc_wake
         with self._cv:
             if self._closing:
                 raise ServingClosed("LMServingEngine is closed")
@@ -1286,8 +1419,13 @@ class LMServingEngine:
                     while (not self._queue and not self._adopt_q
                            and not self._resume_q
                            and not self._n_active and not self._prefilling
-                           and not self._closing and not self._abort):
-                        self._cv.wait()
+                           and not self._closing and not self._abort
+                           and not self._lc_nudge):
+                        if not self._cv.wait(self._lc_wait_timeout()):
+                            # a holding station's deadline came due
+                            # while the engine idled (e.g. a hibernated
+                            # stream): run the sweep
+                            self._lc_nudge = True
                     if self._abort:
                         break
                     if (self._closing and not self._queue
@@ -1298,6 +1436,9 @@ class LMServingEngine:
                         # resolves any still-hibernated streams with
                         # ServingClosed instead of leaving them hanging
                         break
+                    # cancelled/expired requests leave their holding
+                    # stations BEFORE this round admits anything
+                    self._lifecycle_sweep_locked()
                     # in-flight = decoding + mid-prefill: both hold slots
                     inflight = self._n_active + len(self._prefilling)
                     adopts = []
@@ -1382,6 +1523,7 @@ class LMServingEngine:
                             # slots): wait briefly instead of spinning
                             # on the retry
                             self._cv.wait(0.05)
+                self._lifecycle_round()
                 if self._hibernate_req:
                     self._service_hibernations()
                 if self._chunk_cap is not None and self._prefilling:
@@ -1409,6 +1551,210 @@ class LMServingEngine:
             self._fail_all(e)
             return
         self._fail_all(ServingClosed("engine closed before completion"))
+
+    # -- request lifecycle (deadlines / cooperative cancel) ------------- #
+    def _lc_wake(self):
+        """Client-side nudge (installed as ``LMStream._wake_cb``): a
+        cancel must wake an idle worker so it is honored at the NEXT
+        scheduler round, not the next organic one."""
+        with self._cv:
+            self._lc_nudge = True
+            self._cv.notify_all()
+
+    def _lc_count(self, key: str, n: int = 1) -> None:
+        if n <= 0:
+            return
+        with self._lc_lock:
+            self.lifecycle[key] += n
+        self._lc_counters[key].add(n)
+
+    def _lc_wait_timeout(self) -> Optional[float]:
+        """Earliest pending deadline across slot-less holding stations
+        (queued / adoption / resume / hibernated), as a cv-wait bound —
+        an idle engine must still wake to shed an expiring hibernated
+        stream.  Caller holds ``_cv``; None = no deadline pending."""
+        if not self.honor_lifecycle:
+            return None
+        dls = [r.stream.deadline_at for r in self._queue]
+        dls += [h.stream.deadline_at for h in self._adopt_q]
+        dls += [h.stream.deadline_at for h in self._resume_q]
+        dls += [h.stream.deadline_at for h in self._hibernated.values()]
+        dls = [d for d in dls if d is not None]
+        if not dls:
+            return None
+        return max(0.0, min(dls) - time.monotonic()) + 0.005
+
+    def _lc_shed_queued(self, stream: LMStream, rid) -> None:
+        """A queued (never-prefilled) request left the lifecycle: a
+        cancel truncates quietly; a blown deadline is the typed
+        pre-admission shed — counted exactly like an admission-control
+        rejection (``ServingDeadlineExceeded`` is a
+        ``ServingOverloaded``), so SLO/goodput accounting holds."""
+        if stream.cancel_requested:
+            reason = "cancelled"
+            self._lc_count("cancelled")
+            stream._finish_truncated("cancelled")
+        else:
+            reason = "deadline"
+            self.metrics.record_reject()
+            count_rejection()
+            self._lc_count("expired_preadmission")
+            stream._finish(error=ServingDeadlineExceeded(
+                f"deadline ({stream.deadline_s}s) expired before "
+                "prefill; request shed pre-admission"))
+        if _tracer.sampled(rid):
+            _tracer.instant("lm/lifecycle_shed", cat="serve",
+                            request_id=rid, reason=reason,
+                            station="queue")
+
+    def _lc_truncate(self, stream: LMStream, rid, *,
+                     station: str = "seated") -> None:
+        """Finish a request that progressed past admission (blocks
+        were allocated / tokens may have been emitted) with the typed
+        truncation marker; tokens already emitted stay valid."""
+        if stream.cancel_requested:
+            reason = "cancelled"
+            self._lc_count("cancelled")
+        else:
+            reason = "deadline"
+            self._lc_count("expired_midstream")
+        stream._finish_truncated(reason)
+        self.metrics.record_complete()
+        if _tracer.sampled(rid):
+            _tracer.instant("lm/lifecycle_truncate", cat="serve",
+                            request_id=rid, reason=reason,
+                            station=station,
+                            at_tokens=len(stream.generated))
+
+    def _lifecycle_sweep_locked(self) -> None:
+        """Shed cancelled/expired requests from every holding station
+        that owns NO decode slot: the admission queue (the pre-prefill
+        shed), the adoption queue (pre-seat; its retained decode-pool
+        blocks release), the resume queue, and the hibernated set —
+        hibernated streams are cancellable WITHOUT resume: the chain
+        drops straight out of the host tier, no promote transfer.
+        Caller holds ``_cv``."""
+        self._lc_nudge = False
+        if not self.honor_lifecycle:
+            return
+        now = time.monotonic()
+
+        def _dead(stream):
+            return stream.cancel_requested or stream.expired(now)
+
+        if any(_dead(r.stream) for r in self._queue):
+            live = []
+            while self._queue:
+                r = self._queue.popleft()
+                if _dead(r.stream):
+                    self._lc_shed_queued(r.stream, r.rid)
+                else:
+                    live.append(r)
+            self._queue.extend(live)
+        if any(_dead(h.stream) for h in self._adopt_q):
+            live = []
+            while self._adopt_q:
+                h = self._adopt_q.popleft()
+                if _dead(h.stream):
+                    if h.matched:
+                        self.pool.release(h.matched)
+                    self._lc_truncate(h.stream, h.rid, station="adopt_q")
+                else:
+                    live.append(h)
+            self._adopt_q.extend(live)
+        if any(_dead(h.stream) for h in self._resume_q):
+            live = []
+            while self._resume_q:
+                hib = self._resume_q.popleft()
+                if _dead(hib.stream):
+                    # a popped payload rides the handle; dropping the
+                    # handle drops the chain
+                    self._lc_truncate(hib.stream, hib.rid,
+                                      station="resume_q")
+                else:
+                    live.append(hib)
+            self._resume_q.extend(live)
+        for rid in [rid for rid, hib in self._hibernated.items()
+                    if _dead(hib.stream)]:
+            hib = self._hibernated.pop(rid)
+            try:
+                if self.kvtier is not None:
+                    self.kvtier.get(("session", rid), pop=True)
+            except Exception:
+                pass
+            self._lc_truncate(hib.stream, rid, station="hibernated")
+
+    def _lifecycle_round(self) -> None:
+        """Per-round lifecycle pass over the stations that DO hold a
+        decode slot.  The ``serving.cancel`` fault site crosses here —
+        one crossing per seated stream per round, and an injected
+        fault IS that client disconnecting (how the chaos replayer
+        makes a disconnect storm); then cancelled/expired streams are
+        honored same-iteration: slot recycled, blocks released,
+        drafter state dropped, stream finished with the typed
+        truncation marker.  With ``honor_lifecycle=False`` (the bench's
+        ignore-everything baseline) nothing is freed — instead every
+        dead seated slot counts one wasted decode slot-step per round,
+        the work this layer exists to shed."""
+        from bigdl_tpu.resilience.faults import fault_point
+        with self._cv:
+            seated = [st.stream for st in self._slots if st is not None]
+            seated += [pf.req.stream for pf in self._prefilling]
+        for s in seated:
+            try:
+                fault_point("serving.cancel", name=self.name,
+                            rid=s.request_id)
+            except (TransientBackendError, BackendLostError):
+                s.cancel()
+        now = time.monotonic()
+
+        def _dead(stream):
+            return stream.cancel_requested or stream.expired(now)
+
+        if not self.honor_lifecycle:
+            with self._cv:
+                n_dead = sum(1 for st in self._slots
+                             if st is not None and _dead(st.stream))
+            self._lc_count("wasted_decode_steps", n_dead)
+            return
+        with self._cv:
+            if any(_dead(pf.req.stream) for pf in self._prefilling):
+                live = []
+                while self._prefilling:
+                    pf = self._prefilling.popleft()
+                    if _dead(pf.req.stream):
+                        self.pool.release(pf.blocks)
+                        self._free.append(pf.slot)
+                        self._lc_truncate(pf.req.stream, pf.req.rid,
+                                          station="prefilling")
+                    else:
+                        live.append(pf)
+                self._prefilling.extend(live)
+            freed = False
+            for i, st in enumerate(self._slots):
+                if st is None or not _dead(st.stream):
+                    continue
+                s = st.stream
+                # decode steps spent between the cancel landing and
+                # this round honoring it were wasted: count the
+                # residual so the honored arm stays honest too
+                if s.cancel_requested:
+                    self._lc_count(
+                        "wasted_decode_steps",
+                        max(0, len(s._tokens) - s._cancel_at_gen))
+                # identical cleanup to the EOS free path: refcounts
+                # are conserved and the slot is reusable THIS round
+                self._trace_done(s, st.rid)
+                self.pool.release(st.blocks)
+                self._slots[i] = None
+                if self.draft is not None:
+                    self.draft.release(i)
+                self._free.append(i)
+                self._n_active -= 1
+                self._lc_truncate(s, st.rid)
+                freed = True
+            if freed:
+                self._cv.notify_all()
 
     def _mem_pressure_deferred(self) -> bool:
         """Byte-level admission gate: when the memory ledger reads the
@@ -2405,9 +2751,15 @@ class LMServingEngine:
             "hibernations": self.hibernations,
             "resumes": self.resumes,
             "resume_re_prefills": self.resume_re_prefills,
+            "honor_lifecycle": self.honor_lifecycle,
+            "lifecycle": self.lifecycle_stats(),
             "metrics": self.metrics.snapshot(),
             "spec": self._spec_stats(),
         }
+
+    def lifecycle_stats(self) -> dict:
+        with self._lc_lock:
+            return dict(self.lifecycle)
 
     def _spec_stats(self) -> Optional[dict]:
         if self.spec is None:

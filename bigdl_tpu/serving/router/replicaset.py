@@ -38,9 +38,11 @@ import numpy as np
 
 from bigdl_tpu.obs import get_registry, get_tracer
 from bigdl_tpu.obs.tracer import mint_request_id
-from bigdl_tpu.resilience.errors import BackendLostError, classify_error
-from bigdl_tpu.resilience.replicaset import (DRAINING, ReplicaSetCore,
-                                             _Replica)
+from bigdl_tpu.resilience.errors import (BackendLostError,
+                                         ServingDeadlineExceeded,
+                                         classify_error)
+from bigdl_tpu.resilience.replicaset import (DRAINING, HedgePolicy,
+                                             ReplicaSetCore, _Replica)
 from bigdl_tpu.serving.batcher import ServingClosed, ServingOverloaded
 from bigdl_tpu.serving.kvcache.radix import prefix_signatures
 from bigdl_tpu.serving.lm_engine import (LMMetrics, LMServingEngine,
@@ -57,15 +59,33 @@ class RoutedLMStream(LMStream):
     """Client handle for a routed request: an :class:`LMStream` whose
     tokens arrive via the relay, surviving replica failover underneath.
     ``replica_name`` / ``inner`` track the CURRENT placement (they move
-    on failover); ``re_dispatches`` counts the hops."""
+    on failover); ``re_dispatches`` counts the hops; ``hedged`` marks a
+    request that fired a speculative duplicate dispatch."""
 
     def __init__(self, prompt_1b, max_new, request_id=None,
-                 session_id=None):
-        super().__init__(prompt_1b, max_new, request_id=request_id)
+                 session_id=None, deadline_s=None):
+        super().__init__(prompt_1b, max_new, request_id=request_id,
+                         deadline_s=deadline_s)
         self.session_id = session_id
         self.replica_name: Optional[str] = None
         self.inner: Optional[LMStream] = None
         self.re_dispatches = 0
+        self.hedged = False
+        self._hedge_inner: Optional[LMStream] = None
+
+    def cancel(self) -> bool:
+        """Cooperative cancel, propagated through the routed front:
+        the CURRENT inner engine stream (and a hedge duplicate, if one
+        is in flight) each get the cancel, so every replica touching
+        this request recycles its slot at its next scheduler round."""
+        live = super().cancel()
+        for s in (self.inner, self._hedge_inner):
+            if s is not None:
+                try:
+                    s.cancel()
+                except Exception:
+                    pass
+        return live
 
 
 class LMReplicaSet(ReplicaSetCore):
@@ -90,6 +110,12 @@ class LMReplicaSet(ReplicaSetCore):
         failure_threshold / cooldown_s / max_redispatch / clock: the
             :class:`ReplicaSetCore` breaker knobs (max_redispatch
             defaults to ``n_replicas - 1``: try every other member).
+        hedge: a :class:`HedgePolicy` enabling speculative re-dispatch
+            (Spark's speculative execution reborn at stream granularity):
+            a hedge-eligible request whose wait-to-first-token exceeds
+            the policy's windowed tail trigger is duplicated onto the
+            next-best replica; the first stream to finish wins and the
+            loser is cooperatively cancelled.  None (default) disables.
         **engine_kwargs: forwarded to every :class:`LMServingEngine`
             (slots, cache_len, block_len, num_blocks, temperature, ...).
     """
@@ -102,6 +128,7 @@ class LMReplicaSet(ReplicaSetCore):
                  cooldown_s: float = 5.0,
                  max_redispatch: Optional[int] = None,
                  clock=time.monotonic,
+                 hedge: Optional[HedgePolicy] = None,
                  name: str = "lmset",
                  **engine_kwargs):
         if n_replicas < 1:
@@ -110,7 +137,8 @@ class LMReplicaSet(ReplicaSetCore):
             failure_threshold=failure_threshold, cooldown_s=cooldown_s,
             max_redispatch=(int(max_redispatch) if max_redispatch
                             is not None else max(1, n_replicas - 1)),
-            clock=clock, dispatch_policy=self._policy)
+            clock=clock, dispatch_policy=self._policy,
+            hedge_policy=hedge)
         self.name = name
         self.router = router
         self.sessions = sessions if sessions is not None else SessionTable()
@@ -199,6 +227,15 @@ class LMReplicaSet(ReplicaSetCore):
                     f"{last}") from last
             try:
                 inner = rep.engine.submit(prompt, **kw)
+            except ServingDeadlineExceeded:
+                # a blown deadline is a property of the REQUEST, not of
+                # the replica: walking more candidates cannot un-expire
+                # it, and charging the breaker would punish a healthy
+                # member for correct admission control.  Release the
+                # inflight slot as a clean interaction and surface the
+                # typed shed to the caller.
+                self._record_success(rep)
+                raise
             except Exception as e:  # noqa: BLE001 — classified below
                 self._record_failure(rep, e)
                 # a closed MEMBER is a dead replica, not a dead set
@@ -224,11 +261,21 @@ class LMReplicaSet(ReplicaSetCore):
                max_new_tokens: Optional[int] = None,
                temperature: Optional[float] = None,
                eos_id: Optional[int] = None,
-               rng=None) -> RoutedLMStream:
+               rng=None, deadline_s: Optional[float] = None,
+               hedgeable: bool = False) -> RoutedLMStream:
         """Route one prompt; returns a stream that survives the death
         of any replica serving it.  Pass ``rng`` as an int seed when
         ``temperature > 0`` — failover re-submits with the same seed,
-        which is what keeps the replayed tokens identical."""
+        which is what keeps the replayed tokens identical.
+
+        ``deadline_s`` is the request's end-to-end wall-clock budget,
+        minted HERE: failover re-dispatch forwards the REMAINING budget
+        (never a reset one), and each member engine sheds/truncates
+        against the same absolute instant.  ``hedgeable=True`` marks a
+        request the client consumes whole (not token-by-token), making
+        it eligible for the set's :class:`HedgePolicy` speculative
+        duplicate — duplicated decode is invisible only when nobody is
+        watching the stream race."""
         if self._closed:
             raise ServingClosed("LMReplicaSet is closed")
         prompt = np.asarray(prompt_ids).reshape(-1).astype(np.int32)
@@ -238,15 +285,18 @@ class LMReplicaSet(ReplicaSetCore):
             "session_id": session_id,
             "sticky": self.sessions.lookup(session_id),
             "prompt_sigs": prefix_signatures(prompt - 1, self.block_len),
+            "hedgeable": bool(hedgeable),
         }
         kw = dict(max_new_tokens=max_new_tokens, temperature=temperature,
-                  eos_id=eos_id, rng=rng)
+                  eos_id=eos_id, rng=rng, deadline_s=deadline_s)
         tried: set = set()
+        if self.hedge_policy is not None:
+            self.hedge_policy.note_dispatch()
         rep, inner = self._dispatch(prompt, kw, ctx, tried)
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else self.max_new_tokens)
         out = RoutedLMStream(prompt, max_new, request_id=rid,
-                             session_id=session_id)
+                             session_id=session_id, deadline_s=deadline_s)
         out.replica_name, out.inner = rep.name, inner
         t = threading.Thread(
             target=self._relay, args=(out, rep, inner, prompt, kw, ctx),
@@ -257,9 +307,20 @@ class LMReplicaSet(ReplicaSetCore):
     def _relay(self, out: RoutedLMStream, rep, inner, prompt, kw, ctx):
         """Forward the inner stream into the routed one; on a
         re-routable death, re-submit the same request elsewhere and
-        skip what the client already saw (bit-exact replay)."""
+        skip what the client already saw (bit-exact replay).  The relay
+        is also where the request's lifecycle rides the hops: a hedge
+        window opens before the first token, failover forwards the
+        REMAINING deadline budget, and a client cancel noticed here
+        short-circuits re-dispatch entirely."""
         tried: set = set()
         while True:
+            if (self.hedge_policy is not None and ctx.get("hedgeable")
+                    and not out.hedged and len(out.generated) == 0):
+                picked = self._maybe_hedge(out, rep, inner, prompt, kw,
+                                           ctx, tried)
+                if picked is not None:
+                    rep, inner = picked
+                    out.replica_name, out.inner = rep.name, inner
             try:
                 skip = len(out.generated)
                 i = 0
@@ -268,13 +329,52 @@ class LMReplicaSet(ReplicaSetCore):
                     if i > skip:
                         out._emit(tok)
                 self._record_success(rep)
-                out._finish()
+                if self.hedge_policy is not None and not out.hedged:
+                    ttft = inner.ttft_s
+                    if ttft is not None:
+                        self.hedge_policy.observe(ttft)
+                tr = getattr(inner, "truncation", None)
+                if tr is not None and out.truncation is None:
+                    # the member truncated (deadline/cancel honored
+                    # mid-stream): the routed front finishes the same
+                    # way — cleanly, with the typed marker
+                    out._finish_truncated(tr.reason)
+                else:
+                    out._finish()
                 return
             except BaseException as e:  # noqa: BLE001 — classified below
+                if isinstance(e, ServingDeadlineExceeded):
+                    # the member shed a blown deadline: correct
+                    # admission control, not a replica fault — don't
+                    # charge the breaker, don't walk other replicas
+                    self._record_success(rep)
+                    if len(out.generated):
+                        out._finish_truncated("deadline")
+                    else:
+                        out._finish(e)
+                    return
                 self._record_failure(rep, e)
                 if (classify_error(e) == "fatal"
                         and not isinstance(e, ServingClosed)):
                     out._finish(e)
+                    return
+                if out.cancel_requested:
+                    # the client already walked away: re-dispatching
+                    # would burn decode on an unwatched stream
+                    out._finish_truncated("cancelled")
+                    return
+                rem = out.remaining_s()
+                if isinstance(e, ServingDeadlineExceeded) or (
+                        rem is not None and rem <= 0.0):
+                    # the budget died with the replica: no re-dispatch
+                    if len(out.generated):
+                        out._finish_truncated("deadline")
+                    else:
+                        out._finish(e if isinstance(
+                            e, ServingDeadlineExceeded)
+                            else ServingDeadlineExceeded(
+                                f"request {out.request_id} deadline "
+                                f"expired during failover"))
                     return
                 tried.add(rep.name)
                 out.re_dispatches += 1
@@ -300,12 +400,127 @@ class LMReplicaSet(ReplicaSetCore):
                             self.max_redispatch, len(out.generated), e)
                 ctx = dict(ctx)
                 ctx["sticky"] = None   # the sticky replica just failed
+                if rem is not None:
+                    # the re-dispatch inherits what is LEFT of the
+                    # budget, never a fresh one — a hop is not a reason
+                    # to promise the client more time
+                    kw = dict(kw)
+                    kw["deadline_s"] = rem
                 try:
                     rep, inner = self._dispatch(prompt, kw, ctx, tried)
                 except BaseException as e2:  # noqa: BLE001
                     out._finish(e2)
                     return
                 out.replica_name, out.inner = rep.name, inner
+
+    def _maybe_hedge(self, out: RoutedLMStream, rep, inner, prompt, kw,
+                     ctx, tried: set):
+        """Hedge window: wait for the primary's first token up to the
+        policy's tail trigger; past it (and within the hedge budget),
+        duplicate the request onto the next-best replica and race the
+        two streams.  Returns the winning ``(rep, inner)`` pair for the
+        relay to forward, or None to continue with the primary.  Both
+        replicas compute identical tokens (same prompt, same seed), so
+        whichever finishes first IS the answer — the loser is
+        cooperatively cancelled and frees its slot within one scheduler
+        round."""
+        pol = self.hedge_policy
+        trig = pol.trigger_s()
+        if trig is None:
+            return None   # not enough wait evidence to aim a hedge yet
+        with inner._cond:
+            inner._cond.wait_for(
+                lambda: inner._tokens or inner._done,
+                timeout=max(0.0, (out.submitted_at + trig)
+                            - time.perf_counter()))
+            started = bool(inner._tokens) or inner._done
+        if started:
+            return None   # primary is producing (or already resolved)
+        waited = time.perf_counter() - out.submitted_at
+        if not pol.should_hedge(waited):
+            return None
+        hctx = dict(ctx)
+        hctx["sticky"] = None   # the point is a DIFFERENT replica
+        hkw = dict(kw)
+        rem = out.remaining_s()
+        if rem is not None:
+            if rem <= 0.0:
+                return None   # the deadline sweep owns this request now
+            hkw["deadline_s"] = rem
+        try:
+            hrep, hinner = self._dispatch(prompt, hkw, hctx,
+                                          set(tried) | {rep.name})
+        except BaseException:  # noqa: BLE001 — no second seat, no hedge
+            return None
+        pol.note_fired()
+        out.hedged = True
+        out._hedge_inner = hinner
+        if _tracer.sampled(out.request_id):
+            _tracer.instant(
+                "router/hedge_fired", cat="serve",
+                request_id=out.request_id, primary=rep.name,
+                hedge=hrep.name, waited_s=round(waited, 6),
+                trigger_s=round(trig, 6))
+        log.info("%s: request %s hedged %s -> %s (waited %.3fs, "
+                 "trigger %.3fs)", self.name, out.request_id, rep.name,
+                 hrep.name, waited, trig)
+        # a side stream's inflight/breaker accounting settles when its
+        # cancel is honored (next scheduler round on its engine) — a
+        # tiny waiter keeps the relay free to forward the winner NOW
+        def _settle(side_stream, side_rep):
+            def _run():
+                with side_stream._cond:
+                    side_stream._cond.wait_for(
+                        lambda: side_stream._done, timeout=30.0)
+                if side_stream._error is not None:
+                    self._record_failure(side_rep, side_stream._error)
+                else:
+                    self._record_success(side_rep)
+            threading.Thread(target=_run, daemon=True,
+                             name=f"{self.name}-hedge-settle-"
+                                  f"{out.request_id}").start()
+
+        # first completion WITHOUT an error wins; a mid-hedge replica
+        # kill resolves its stream with an error, which simply forfeits
+        # the race to the survivor.  Both dead -> hand the primary back
+        # and let the relay's failover path re-dispatch (both names are
+        # in ``tried``).
+        while True:
+            p_done, h_done = inner.done(), hinner.done()
+            if p_done and inner._error is None:
+                winner, wrep = inner, rep
+                loser, lrep, hedge_won = hinner, hrep, False
+                break
+            if h_done and hinner._error is None:
+                winner, wrep = hinner, hrep
+                loser, lrep, hedge_won = inner, rep, True
+                break
+            if p_done and h_done:
+                tried.add(hrep.name)
+                self._record_failure(hrep, hinner._error)
+                pol.note_outcome(False)
+                out._hedge_inner = None
+                return None
+            if out.cancel_requested:
+                # client cancelled mid-race: both inners already got
+                # the cancel via RoutedLMStream.cancel; let the relay's
+                # normal path observe the primary's truncation, and
+                # settle the hedge seat when its cancel lands
+                pol.note_outcome(False)
+                out._hedge_inner = None
+                _settle(hinner, hrep)
+                return None
+            time.sleep(0.002)
+        loser.cancel()
+        pol.note_outcome(hedge_won)
+        out._hedge_inner = None
+        if _tracer.sampled(out.request_id):
+            _tracer.instant(
+                "router/hedge_resolved", cat="serve",
+                request_id=out.request_id, winner=wrep.name,
+                hedge_won=hedge_won)
+        _settle(loser, lrep)
+        return wrep, winner
 
     # -- hibernation (composes with kvtier) ------------------------------- #
     def hibernate(self, stream: RoutedLMStream, *,
@@ -412,6 +627,18 @@ class LMReplicaSet(ReplicaSetCore):
         return sum(e.warmup_prefix(suffix_lens, prefix_blocks)
                    for e in engines)
 
+    def lifecycle_stats(self) -> dict:
+        """Set-wide lifecycle accounting: the SUM of every member's
+        expired/cancelled/wasted counters (the bench's goodput and
+        zero-loss gates read the set, not a replica)."""
+        with self._lock:
+            engines = [r.engine for r in self._replicas]
+        total: dict = {}
+        for eng in engines:
+            for k, v in eng.lifecycle_stats().items():
+                total[k] = total.get(k, 0) + v
+        return total
+
     def stats(self) -> dict:
         with self._lock:
             replicas = {
@@ -430,6 +657,9 @@ class LMReplicaSet(ReplicaSetCore):
             "hibernations": self.hibernations,
             "resumes": self.resumes,
             "resume_re_routes": self.resume_re_routes,
+            "lifecycle": self.lifecycle_stats(),
+            "hedge": (self.hedge_policy.stats()
+                      if self.hedge_policy is not None else None),
             "metrics": self.metrics.snapshot(),
         }
 

@@ -58,6 +58,11 @@ def _map_incident(incident: dict) -> tuple:
     rc = int(incident.get("rc", 1))
     if "probe" in stage or "init" in stage:
         return "engine.init", "transient"
+    if "deadline" in stage or "cancel" in stage or "disconnect" in stage:
+        # lifecycle-stage incidents replay as client disconnects: the
+        # serving.cancel site turns any injected fault into a
+        # cooperative stream.cancel at the next scheduler round
+        return "serving.cancel", "transient"
     if rc == 0:
         return "serving.enqueue", "transient"
     if "lm" in stage or "serv" in stage:

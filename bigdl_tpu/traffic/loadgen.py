@@ -39,24 +39,40 @@ KINDS = ("poisson", "bursty", "diurnal")
 
 
 class Arrival:
-    """One scheduled request: submit at ``at_s`` after trace start."""
+    """One scheduled request: submit at ``at_s`` after trace start.
 
-    __slots__ = ("index", "at_s", "prompt", "max_new")
+    ``deadline_s`` / ``cancel_after_s`` are the request's LIFECYCLE
+    shape: the wall-clock budget the client attaches at enqueue and the
+    instant (after submit) the client walks away — both drawn from
+    seeded menus like prompt/max_new, both None when the trace carries
+    no lifecycle traffic.  They describe client behavior, so the
+    generator only records them; honoring them is the server's job."""
+
+    __slots__ = ("index", "at_s", "prompt", "max_new", "deadline_s",
+                 "cancel_after_s")
 
     def __init__(self, index: int, at_s: float, prompt: np.ndarray,
-                 max_new: int):
+                 max_new: int, deadline_s: Optional[float] = None,
+                 cancel_after_s: Optional[float] = None):
         self.index = index
         self.at_s = at_s
         self.prompt = prompt        # (t,) int32, 1-based ids
         self.max_new = max_new
+        self.deadline_s = deadline_s
+        self.cancel_after_s = cancel_after_s
 
     @property
     def prompt_len(self) -> int:
         return int(self.prompt.shape[0])
 
     def __repr__(self):  # pragma: no cover - debugging nicety
+        extra = ""
+        if self.deadline_s is not None:
+            extra += f", deadline={self.deadline_s:.3f}s"
+        if self.cancel_after_s is not None:
+            extra += f", cancel_after={self.cancel_after_s:.3f}s"
         return (f"Arrival({self.index}, at={self.at_s:.3f}s, "
-                f"t={self.prompt_len}, max_new={self.max_new})")
+                f"t={self.prompt_len}, max_new={self.max_new}{extra})")
 
 
 class LoadReport:
@@ -100,6 +116,17 @@ class TraceLoadGenerator:
             ``burst_duty`` fraction of every period runs at
             ``burst_factor`` x the mean rate.
         diurnal_floor: trough rate as a fraction of the peak.
+        deadline_menu: per-request wall-clock budgets (seconds) drawn
+            uniformly like the prompt/max_new menus; entries of None
+            mean "no deadline" so a menu can mix bounded and unbounded
+            traffic.  Empty/None menu (default): no deadlines at all.
+        deadline_fraction: probability an arrival draws from
+            ``deadline_menu`` at all (seeded), letting a trace carry a
+            minority of deadline-bound requests.
+        cancel_after_menu / cancel_fraction: same shape for client
+            disconnects — ``cancel_after_s`` seconds after submit the
+            client stops listening (the driver calls
+            ``stream.cancel()``).
     """
 
     def __init__(self, *, kind: str = "poisson",
@@ -112,7 +139,11 @@ class TraceLoadGenerator:
                  burst_factor: float = 3.0,
                  burst_period_s: float = 2.0,
                  burst_duty: float = 0.3,
-                 diurnal_floor: float = 0.2):
+                 diurnal_floor: float = 0.2,
+                 deadline_menu=None,
+                 deadline_fraction: float = 1.0,
+                 cancel_after_menu=None,
+                 cancel_fraction: float = 1.0):
         if kind not in KINDS:
             raise ValueError(f"unknown trace kind {kind!r} "
                              f"(expected one of {KINDS})")
@@ -134,6 +165,16 @@ class TraceLoadGenerator:
         self.burst_period_s = float(burst_period_s)
         self.burst_duty = float(burst_duty)
         self.diurnal_floor = float(diurnal_floor)
+        if not (0.0 <= deadline_fraction <= 1.0):
+            raise ValueError("deadline_fraction must be in [0, 1]")
+        if not (0.0 <= cancel_fraction <= 1.0):
+            raise ValueError("cancel_fraction must be in [0, 1]")
+        self.deadline_menu = (None if not deadline_menu else tuple(
+            (None if d is None else float(d)) for d in deadline_menu))
+        self.deadline_fraction = float(deadline_fraction)
+        self.cancel_after_menu = (None if not cancel_after_menu else tuple(
+            (None if c is None else float(c)) for c in cancel_after_menu))
+        self.cancel_fraction = float(cancel_fraction)
 
     def config(self) -> dict:
         """Everything that determines the trace — artifact row header."""
@@ -145,7 +186,13 @@ class TraceLoadGenerator:
                 "burst_factor": self.burst_factor,
                 "burst_period_s": self.burst_period_s,
                 "burst_duty": self.burst_duty,
-                "diurnal_floor": self.diurnal_floor}
+                "diurnal_floor": self.diurnal_floor,
+                "deadline_menu": (list(self.deadline_menu)
+                                  if self.deadline_menu else None),
+                "deadline_fraction": self.deadline_fraction,
+                "cancel_after_menu": (list(self.cancel_after_menu)
+                                      if self.cancel_after_menu else None),
+                "cancel_fraction": self.cancel_fraction}
 
     # -- rate shape ----------------------------------------------------- #
     def _rate_at(self, t: float) -> float:
@@ -188,7 +235,23 @@ class TraceLoadGenerator:
             mn = self.max_news[int(rng.randint(len(self.max_news)))]
             prompt = rng.randint(1, self.vocab + 1, size=pl) \
                 .astype(np.int32)
-            arrivals.append(Arrival(len(arrivals), t, prompt, mn))
+            # lifecycle draws ALWAYS consume RNG when a menu is set, so
+            # a trace's prompts/timings are identical whether a given
+            # arrival ends up bounded or not (same seed, same trace)
+            dl = None
+            if self.deadline_menu:
+                pick = self.deadline_menu[
+                    int(rng.randint(len(self.deadline_menu)))]
+                take = float(rng.random_sample()) < self.deadline_fraction
+                dl = pick if take else None
+            ca = None
+            if self.cancel_after_menu:
+                pick = self.cancel_after_menu[
+                    int(rng.randint(len(self.cancel_after_menu)))]
+                take = float(rng.random_sample()) < self.cancel_fraction
+                ca = pick if take else None
+            arrivals.append(Arrival(len(arrivals), t, prompt, mn,
+                                    deadline_s=dl, cancel_after_s=ca))
         return arrivals
 
     # -- open-loop replay ------------------------------------------------ #

@@ -64,6 +64,7 @@ if [ "$SMOKE" = "1" ]; then
   QCOMPUTE_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1 --duel-iters 2"
   KVTIER_ARGS="--probes 2 --slots 2 --cache-len 64 --block-len 8 --sessions 6 --rounds 2 --timing-samples 3"
   ROUTER_ARGS="--sessions 3 --turns 2 --slots 2 --cache-len 256 --block-len 8 --max-new 8 --prompt-blocks 16"
+  DEADLINE_ARGS="--rate 8 --duration 1.5 --slots 2 --cache-len 96 --block-len 16"
   MEMPROFILE_ARGS="--requests 4 --slots 2 --cache-len 64 --block-len 8 --spec-k 2"
   PREFIX_ARGS="--requests 6 --slots 2 --cache-len 96 --shared-len 32 --mean-gap-ms 5 --probes 1"
   DISAGG_ARGS="--requests 8 --slots 4 --cache-len 128 --chunk-tokens 16 --mean-gap-ms 5 --probes 1"
@@ -94,6 +95,7 @@ else
   QCOMPUTE_ARGS="--requests 24 --slots 8 --cache-len 128"
   KVTIER_ARGS=""
   ROUTER_ARGS=""
+  DEADLINE_ARGS=""
   MEMPROFILE_ARGS="--requests 8 --slots 4 --cache-len 128"
   PREFIX_ARGS="--requests 24 --slots 8 --cache-len 128 --shared-len 64"
   DISAGG_ARGS="--requests 24 --slots 8 --cache-len 128 --chunk-tokens 32"
@@ -135,7 +137,8 @@ ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json TUNE_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
 BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json BENCH_MESH.json \
 BENCH_SPEC.json BENCH_DISAGG.json BENCH_QCOMPUTE.json \
-BENCH_KVTIER.json BENCH_ROUTER.json PROFILE_MEM.json \
+BENCH_KVTIER.json BENCH_ROUTER.json BENCH_DEADLINE.json \
+PROFILE_MEM.json \
 flight/FLIGHT_*.json TRACE_*.json \
 PROFILE_TPU.json TUNNEL_STRESS.json TUNNEL_INCIDENTS.json \
 CONVERGENCE_r05.json CONVERGENCE_CPU.json \
@@ -407,6 +410,29 @@ router_stage() {
   return 1
 }
 
+# deadline rides right after router: request-lifecycle robustness
+# (end-to-end deadlines, cooperative cancellation, hedged dispatch)
+# replayed honor-vs-ignore plus a disconnect-storm + replica-kill
+# chaos arm.  On a real chip the wasted-decode and goodput deltas
+# measure actual device decode steps reclaimed, and the chaos replay
+# proves zero accepted loss through the real sampler.  Streams move
+# only token ids (< 1 KB), far below the 32 MB relay ceiling.  Same
+# ok_lm gate (the committed CPU BENCH_DEADLINE.json must never mark
+# the TPU stage done) and the same never-gates-the-round contract.
+deadline_stage() {
+  ok_lm BENCH_DEADLINE.json && return 0
+  say "stage deadline: firing (budget 600s): python -u bench.py --serve-lm --deadline $DEADLINE_ARGS"
+  timeout 600 python -u bench.py --serve-lm --deadline $DEADLINE_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm BENCH_DEADLINE.json; then
+    say "stage deadline: DONE"
+    return 0
+  fi
+  say "stage deadline: not done (rc=$rc)"
+  record_incident deadline "$rc"
+  return 1
+}
+
 # memprofile rides right after kvtier: it builds the whole serving
 # stack (batch engine, LM engine with int8 drafter + host KV tier) and
 # snapshots the memory ledger — on a real chip the reconciliation runs
@@ -584,6 +610,7 @@ while :; do
     qcompute_stage
     kvtier_stage
     router_stage
+    deadline_stage
     memprofile_stage
     mesh_stage
     prefix_stage

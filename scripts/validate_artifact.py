@@ -344,6 +344,70 @@ def _router_problems(doc) -> list:
     return probs
 
 
+def _deadline_problems(doc) -> list:
+    """BENCH_DEADLINE.json extras: the lifecycle machinery is only
+    evidence when (a) it never changed a surviving token — agreement
+    must be exactly 1.0 on every stage — (b) the chaos stage (client
+    disconnect storm + replica kill mid-hedge) lost zero accepted
+    requests, and (c) both arms report numeric wasted-decode and
+    goodput so the strictly-better claims are checkable."""
+    probs = []
+    if doc.get("error"):
+        return probs
+    rows = {r.get("stage"): r for r in doc.get("rows", [])
+            if isinstance(r, dict)}
+    for i, r in enumerate(doc.get("rows", [])):
+        if isinstance(r, dict) and "stage" not in r:
+            probs.append("deadline row %d lacks a 'stage' key" % i)
+    if doc.get("complete") is not True:
+        return probs
+    for stage in ("lifecycle", "baseline", "chaos"):
+        r = rows.get(stage)
+        if not isinstance(r, dict) or r.get("agreement") != 1.0:
+            probs.append("complete deadline artifact: %s agreement "
+                         "must be exactly 1.0, got %r"
+                         % (stage, (r or {}).get("agreement")))
+        if isinstance(r, dict) and r.get("accepted_loss") != 0:
+            probs.append("complete deadline artifact: %s accepted_loss "
+                         "must be exactly 0, got %r"
+                         % (stage, r.get("accepted_loss")))
+    lc = rows.get("lifecycle") or {}
+    bl = rows.get("baseline") or {}
+    lw, bw = lc.get("wasted_decode_steps"), bl.get("wasted_decode_steps")
+    if not (isinstance(lw, int) and isinstance(bw, int) and lw < bw):
+        probs.append("complete deadline artifact: lifecycle "
+                     "wasted_decode_steps must be a strict int "
+                     "improvement over baseline, got lifecycle=%r "
+                     "baseline=%r" % (lw, bw))
+    lg, bg = lc.get("goodput_rps"), bl.get("goodput_rps")
+    if not (isinstance(lg, (int, float)) and isinstance(bg, (int, float))
+            and lg > bg):
+        probs.append("complete deadline artifact: lifecycle goodput_rps "
+                     "must be strictly above baseline, got lifecycle=%r "
+                     "baseline=%r" % (lg, bg))
+    summ = doc.get("summary")
+    if not isinstance(summ, dict):
+        probs.append("complete deadline artifact lacks a summary")
+        return probs
+    if summ.get("agreement") != 1.0:
+        probs.append("complete deadline artifact: summary.agreement "
+                     "must be exactly 1.0, got %r"
+                     % (summ.get("agreement"),))
+    if summ.get("chaos_zero_accepted_loss") is not True:
+        probs.append("complete deadline artifact: "
+                     "summary.chaos_zero_accepted_loss must be true, "
+                     "got %r" % (summ.get("chaos_zero_accepted_loss"),))
+    for key in ("wasted_decode_steps", "goodput_rps"):
+        v = summ.get(key)
+        if not (isinstance(v, dict)
+                and isinstance(v.get("lifecycle"), (int, float))
+                and isinstance(v.get("baseline"), (int, float))):
+            probs.append("complete deadline artifact: summary.%s must "
+                         "report numeric lifecycle+baseline arms, "
+                         "got %r" % (key, v))
+    return probs
+
+
 def _memprofile_problems(doc) -> list:
     """PROFILE_MEM.json extras: the memory-ledger profile is only
     evidence when the attribution actually happened — a complete doc
@@ -430,6 +494,8 @@ def _problems(doc, name: str = "") -> list:
             probs.extend(_kvtier_problems(doc))
         if name == "BENCH_ROUTER.json":
             probs.extend(_router_problems(doc))
+        if name == "BENCH_DEADLINE.json":
+            probs.extend(_deadline_problems(doc))
         if name == "PROFILE_MEM.json":
             probs.extend(_memprofile_problems(doc))
         return probs
