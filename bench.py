@@ -1600,6 +1600,337 @@ def _serve_lm_spec_bench(argv) -> int:
 
 
 # ---------------------------------------------------------------------------
+# --serve-lm --spec2: adaptive tree verify + prompt lookup -> BENCH_SPEC2.json
+# ---------------------------------------------------------------------------
+
+def _spec2_workload(family: str, n_requests: int, vocab: int,
+                    mean_gap_ms: float, rng):
+    """Deterministic arrival trace for one Speculation 2.0 family:
+    (arrive_at_s, prompt, max_new, temperature, seed) per request.
+
+    ``mixed`` alternates greedy and sampled requests, ``sampled`` is
+    all-sampled (temperatures 0.7/1.0/1.3 — where Gumbel-coupled
+    alternates catch runner-up draws), ``copy`` is greedy over prompts
+    built from a repeated n-gram block, the quote-your-input shape
+    prompt lookup feeds on."""
+    import numpy as np
+    work, at = [], 0.0
+    for i in range(n_requests):
+        if family == "copy":
+            base = rng.randint(1, vocab + 1, size=6).astype(np.int32)
+            prompt = np.tile(base, 5)[:24].astype(np.int32)
+            m, temp, seed = 48, 0.0, None
+        else:
+            t = _LM_PROMPT_LENS[rng.randint(len(_LM_PROMPT_LENS))]
+            m = _LM_MAX_NEWS[rng.randint(len(_LM_MAX_NEWS))]
+            prompt = rng.randint(1, vocab + 1, size=t).astype(np.int32)
+            if family == "sampled" or (family == "mixed" and i % 2 == 1):
+                temp = (0.7, 1.0, 1.3)[rng.randint(3)]
+                seed = 1000 + i
+            else:
+                temp, seed = 0.0, None
+        work.append((at, prompt, m, temp, seed))
+        at += float(rng.exponential(mean_gap_ms / 1000.0))
+    return work
+
+
+def _noisy_drafter(model, scale: float, seed: int = 11):
+    """The weak-drafter proxy: a clone of the target with seeded
+    Gaussian noise (``scale`` x per-leaf std) added to every param.
+    An int8 clone of a random float target agrees near-100% — no
+    headroom for tree alternates to show anything — while a noisy
+    clone's acceptance is tunable and its rank-2 pick often IS the
+    target's pick where rank-1 isn't, the regime tree verify exists
+    for."""
+    import jax
+    import jax.numpy as jnp
+
+    d = model.clone_module()
+    leaves, treedef = jax.tree_util.tree_flatten(model.params)
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for leaf in leaves:
+        key, sub = jax.random.split(key)
+        out.append(leaf + scale * jnp.std(leaf)
+                   * jax.random.normal(sub, leaf.shape, leaf.dtype))
+    d.params = jax.tree_util.tree_unflatten(treedef, out)
+    return d
+
+
+def _spec2_stage(eng, model, work, probes: int, warm: int = 2) -> dict:
+    """Replay one spec2 trace (temperatures + seeds carried per
+    request) and probe the first ``probes`` requests for bit-exactness
+    against offline ``generate`` under the SAME temperature/key chain —
+    the agreement gate every arm must score 1.0 on.
+
+    The first ``warm`` requests run once UNTIMED at a token budget of 4
+    (a warm lap: process-global lazy state — XLA autotuning, thread
+    pools, host JIT — otherwise flatters whichever arm runs later),
+    and every per-round statistic is a delta across the timed lap."""
+    import jax
+    import numpy as np
+    from bigdl_tpu.models.transformer.generate import generate
+
+    for _, prompt, _, temp, seed in work[:warm]:
+        eng.submit(prompt, max_new_tokens=4, temperature=temp,
+                   rng=seed).result(timeout=600)
+    before = eng.spec_metrics.snapshot()
+
+    t0 = time.perf_counter()
+    streams = []
+    for arrive_at, prompt, max_new, temp, seed in work:
+        lag = arrive_at - (time.perf_counter() - t0)
+        if lag > 0:
+            time.sleep(lag)
+        streams.append(eng.submit(prompt, max_new_tokens=max_new,
+                                  temperature=temp, rng=seed))
+    outs = [s.result(timeout=600) for s in streams]
+    t_end = max(s.finished_at for s in streams)
+    useful = int(sum(len(s.generated) for s in streams))
+    exact = 0
+    for (arrive_at, prompt, max_new, temp, seed), out in (
+            list(zip(work, outs))[:probes]):
+        kw = {"temperature": temp}
+        if seed is not None:
+            kw["rng"] = jax.random.PRNGKey(seed)
+        ref = np.asarray(generate(model, model.params, prompt[None],
+                                  max_new, **kw))
+        exact += int(np.array_equal(out, ref[0]))
+    span = t_end - t0
+    spec = eng.stats()["spec"]
+
+    def delta(key):
+        return spec[key] - before[key]
+
+    rounds = delta("verify_rounds")
+    drafted = delta("drafted")
+    return {
+        "requests": len(work),
+        "tokens": useful,
+        "duration_s": round(span, 3),
+        "tokens_per_s": round(useful / span, 2),
+        "acceptance_rate": (round(delta("accepted") / drafted, 4)
+                            if drafted else None),
+        "accepted_per_verify_step": (round(delta("emitted") / rounds, 4)
+                                     if rounds else None),
+        "draft_steps": delta("draft_steps"),
+        "draft_overhead": (round(delta("draft_steps") / delta("emitted"), 4)
+                           if delta("emitted") else None),
+        "tree_rounds": delta("tree_rounds"),
+        "alt_accepts": delta("alt_accepts"),
+        "demotions": delta("demotions"),
+        "drafter_compute": spec["draft"]["compute_mode"],
+        "verify_compiles": spec["verify_compiles"],
+        "commit_compiles": spec.get("commit_compiles"),
+        "draft_decode_compiles": eng.draft.decode_compiles,
+        "agreement_probes": probes,
+        "agreement": round(exact / probes, 4) if probes else None,
+    }
+
+
+def _serve_lm_spec2_bench(argv) -> int:
+    """Speculation 2.0 benchmark -> BENCH_SPEC2.json.
+
+    Six arms, three trace families, one resumable artifact:
+
+    - ``linear_mixed`` / ``tree_mixed`` and ``linear_sampled`` /
+      ``tree_sampled``: fixed linear-k chain vs adaptive-depth token
+      tree at EQUAL drafter budget (same spine k, same drafter, same
+      trace) — the tree's alternates catch runner-up draws and its
+      rung ladder adapts per slot to the acceptance EMA.
+    - ``model_copy`` / ``ngram_copy``: int8-clone model drafting vs
+      zero-model prompt lookup on the copy-heavy trace; the n-gram arm
+      speculates deeper (``--ngram-k``) because its drafts cost zero
+      decode steps.
+
+    Every arm runs the same exactness probes; ``complete: true``
+    additionally requires the tree to beat linear on >= 1 family, the
+    n-gram drafter to beat model drafting on the copy trace, and every
+    tree arm to hold exactly one donated verify executable per ladder
+    rung."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="bench.py --serve-lm --spec2")
+    ap.add_argument("--json", default=None)
+    ap.add_argument("--requests", type=int, default=int(
+        os.environ.get("BIGDL_TPU_SERVE_LM_REQUESTS", "16")),
+        help="requests per arm")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--cache-len", type=int, default=128)
+    ap.add_argument("--block-len", type=int, default=16)
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="spine budget for the linear AND tree arms")
+    ap.add_argument("--ngram-k", type=int, default=8,
+                    help="spine budget for the zero-cost n-gram arm")
+    ap.add_argument("--drafter-noise", type=float, default=0.5,
+                    help="weak-drafter proxy: Gaussian noise scale "
+                         "(x per-leaf std) added to the drafter clone")
+    ap.add_argument("--promote-above", type=float, default=0.5,
+                    help="tree-arm rung promotion threshold")
+    ap.add_argument("--mean-gap-ms", type=float, default=15.0)
+    ap.add_argument("--probes", type=int, default=3,
+                    help="requests probed for bit-exactness per arm "
+                         "(every arm must score 1.0)")
+    args = ap.parse_args(argv)
+    if args.json is None:
+        args.json = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_SPEC2.json")
+
+    from bigdl_tpu.utils.engine import select_platform
+    select_platform(os.environ.get("BIGDL_TPU_BENCH_PLATFORM"),
+                    honor_jax_platforms=True)
+    import jax
+    import numpy as np
+    from bigdl_tpu.models.transformer import TransformerLM
+    from bigdl_tpu.serving import LMServingEngine, SpecConfig
+    from bigdl_tpu.serving.spec import default_tree_shapes
+    from bigdl_tpu.utils import artifacts
+
+    platform = jax.devices()[0].platform
+    n_rungs = len(default_tree_shapes(args.spec_k))
+    config = {"model": "transformer_lm", "vocab": 256, "hidden": 128,
+              "heads": 4, "layers": 4, "max_len": args.cache_len,
+              "pos": "rope", "slots": args.slots,
+              "cache_len": args.cache_len,
+              "layout": "paged", "block_len": args.block_len,
+              "spec_k": args.spec_k, "ngram_k": args.ngram_k,
+              "drafter_noise": args.drafter_noise,
+              "tree_rungs": n_rungs,
+              "promote_above": args.promote_above,
+              "sampling": "replay",
+              "requests": args.requests,
+              "mean_gap_ms": args.mean_gap_ms,
+              "families": ["mixed", "sampled", "copy"],
+              "prompt_lens": list(_LM_PROMPT_LENS),
+              "max_news": list(_LM_MAX_NEWS)}
+    prev = artifacts.load_resumable_rows(
+        args.json,
+        match=lambda doc, r: (doc.get("platform") == platform
+                              and doc.get("config") == config
+                              and not r.get("error")),
+        key=lambda r: r.get("stage"))
+
+    rows: list = []
+    result = {"bench": "lm_serving_speculation2",
+              "platform": platform,
+              "config": config, "rows": rows, "complete": False}
+
+    def flush():
+        artifacts.write_artifact(args.json, result)
+
+    flush()
+    model = TransformerLM(
+        vocab_size=config["vocab"], hidden_size=config["hidden"],
+        n_head=config["heads"], n_layers=config["layers"],
+        max_len=args.cache_len, pos_encoding="rope").build(seed=7)
+    traces = {
+        fam: _spec2_workload(fam, args.requests, config["vocab"],
+                             args.mean_gap_ms,
+                             np.random.RandomState(seed))
+        for fam, seed in (("mixed", 0), ("sampled", 1), ("copy", 2))}
+
+    drafter = _noisy_drafter(model, args.drafter_noise)
+
+    def _tree_cfg(k):
+        shapes = default_tree_shapes(k)
+        return SpecConfig(k=k, tree=True, draft=drafter,
+                          promote_above=args.promote_above,
+                          init_rung=len(shapes) - 1)
+
+    # (stage, family, SpecConfig thunk, expected verify executables).
+    # Tree/ngram arms run BEFORE their baselines: residual
+    # process-global warm-up the warm lap misses then favors the
+    # baseline, so it cannot manufacture the claimed wins.
+    arms = [
+        ("tree_mixed", "mixed", lambda: _tree_cfg(args.spec_k), n_rungs),
+        ("linear_mixed", "mixed",
+         lambda: SpecConfig(k=args.spec_k, draft=drafter), 1),
+        ("tree_sampled", "sampled",
+         lambda: _tree_cfg(args.spec_k), n_rungs),
+        ("linear_sampled", "sampled",
+         lambda: SpecConfig(k=args.spec_k, draft=drafter), 1),
+        ("ngram_copy", "copy",
+         lambda: SpecConfig(k=args.ngram_k, drafter_compute="ngram"), 1),
+        ("model_copy", "copy",
+         lambda: SpecConfig(k=args.spec_k, draft=drafter), 1),
+    ]
+    for name, family, mk_cfg, expect_verify in arms:
+        if name in prev:
+            row = dict(prev[name])
+            row["reused_from_previous_run"] = True
+            rows.append(row)
+            flush()
+            continue
+        eng = LMServingEngine(model, slots=args.slots,
+                              cache_len=args.cache_len,
+                              block_len=args.block_len,
+                              max_queue=max(args.requests, 256),
+                              spec=mk_cfg(), name=f"lm-{name}")
+        try:
+            t0 = time.perf_counter()
+            eng.warmup()
+            warm_s = round(time.perf_counter() - t0, 3)
+            row = {"stage": name, "family": family,
+                   **_spec2_stage(eng, model, traces[family],
+                                  args.probes)}
+            row["warmup_s"] = warm_s
+            row["expected_verify_compiles"] = expect_verify
+        finally:
+            eng.close()
+        rows.append(row)
+        flush()
+
+    by = {r["stage"]: r for r in rows}
+    bad = [n for n, r in by.items() if r["agreement"] != 1.0]
+    if args.probes and bad:
+        print(f"bench: SPEC2 AGREEMENT != 1.0 on {bad} — speculative "
+              "streams diverged from offline generate; artifact left "
+              "incomplete", file=sys.stderr)
+        flush()
+        return 1
+    aps = {n: r["accepted_per_verify_step"] for n, r in by.items()}
+    tree_beats = {
+        fam: (aps[f"tree_{fam}"] or 0) > (aps[f"linear_{fam}"] or 0)
+        for fam in ("mixed", "sampled")}
+    ngram_beats = (aps["ngram_copy"] or 0) > (aps["model_copy"] or 0)
+    exec_ok = all(r["verify_compiles"] == r["expected_verify_compiles"]
+                  for r in by.values())
+    result["summary"] = {
+        "accepted_per_verify_step": aps,
+        "tokens_per_s": {n: r["tokens_per_s"] for n, r in by.items()},
+        "tree_beats_linear": tree_beats,
+        "ngram_beats_model": ngram_beats,
+        "ngram_draft_steps": by["ngram_copy"]["draft_steps"],
+        "tree_alt_accepts": {n: by[n]["alt_accepts"]
+                             for n in ("tree_mixed", "tree_sampled")},
+        "verify_executables": {n: r["verify_compiles"]
+                               for n, r in by.items()},
+        "executables_bounded": exec_ok,
+        "agreement": 1.0,
+        "spec_k": args.spec_k, "ngram_k": args.ngram_k,
+    }
+    gates = {"tree_beats_linear_any": any(tree_beats.values()),
+             "ngram_beats_model": ngram_beats,
+             "executables_bounded": exec_ok}
+    failed = [g for g, ok in gates.items() if not ok]
+    if failed:
+        print(f"bench: SPEC2 gates failed: {failed} — artifact left "
+              "incomplete", file=sys.stderr)
+        flush()
+        return 1
+    result["complete"] = True
+    flush()
+    print(json.dumps({
+        "metric": "lm_serving_spec2_accepted_per_verify_step",
+        "value": aps["tree_sampled"],
+        "unit": "tokens/verify_round", "platform": platform,
+        **{k: v for k, v in result["summary"].items()
+           if k not in ("accepted_per_verify_step",)},
+        "accepted_per_verify_step": aps}), flush=True)
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --serve-lm --spec --qcompute: int8-compute drafter duel -> BENCH_QCOMPUTE.json
 # ---------------------------------------------------------------------------
 
@@ -3941,6 +4272,10 @@ if __name__ == "__main__":
         sys.exit(_serve_lm_kvtier_bench(
             [a for a in sys.argv[1:]
              if a not in ("--serve-lm", "--kvtier")]))
+    if "--serve-lm" in sys.argv and "--spec2" in sys.argv:
+        sys.exit(_serve_lm_spec2_bench(
+            [a for a in sys.argv[1:]
+             if a not in ("--serve-lm", "--spec2")]))
     if "--serve-lm" in sys.argv and "--spec" in sys.argv:
         sys.exit(_serve_lm_spec_bench(
             [a for a in sys.argv[1:]

@@ -26,6 +26,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from bigdl_tpu.models.transformer import TransformerLM
@@ -500,6 +501,170 @@ def _verify_step_paged(model, params, tokens, pos, n_cand, tables,
     if quantized:
         return logits, k_arena, v_arena, k_scale, v_scale
     return logits, k_arena, v_arena
+
+
+def _tree_verify_step_paged(model, params, tokens, pos, n_cand, tables,
+                            k_arena, v_arena, k_scale=None, v_scale=None,
+                            *, depths, anc):
+    """Tree-speculative VERIFY over paged caches: score all W nodes of a
+    fixed-shape candidate TREE per slot in one step.  ``tokens`` (S, W)
+    holds one token per tree node (node 0 = the last emitted root, the
+    shape's topological order), ``depths`` (W,) and ``anc`` (W, W) are
+    the shape's static per-node depths and ancestor-or-self matrix —
+    baked into the trace, one executable per shape.
+
+    Node j stores its k/v at arena offset ``pos + j`` (a unique slot per
+    node — siblings share a POSITION but never an offset) while RoPE
+    rotates it at its TRUE position ``pos + depths[j]``, and its mask
+    admits the committed prefix (``col < pos``) plus exactly its
+    ancestor offsets.  A path node at depth d therefore attends the same
+    (position, key) set as linear-verify row d — identical f32
+    gather/score/softmax math, so logits along any root-to-leaf path are
+    bit-identical to ``_verify_step_paged`` scoring that path as a
+    chain, and for chain shapes (``anc`` lower-triangular, ``depths[j]
+    == j``) the whole step IS the linear verify.  After the host walk
+    accepts a path, ``_tree_commit_paged`` copies accepted OFF-SPINE
+    rows down to their position offsets; rejected rows are garbage above
+    the rewound pointer exactly as in linear verify.  Rows >= ``n_cand``
+    (lower-rung or plain slots riding a wider executable) scatter to the
+    scratch block."""
+    mha = model._mha
+    s, w = tokens.shape
+    m = tables.shape[1]
+    B = k_arena.shape[3]
+    ctx = m * B
+    offs = jnp.arange(w)
+    depths = jnp.asarray(depths, jnp.int32)          # (W,) static
+    ancm = jnp.asarray(np.asarray(anc), bool)        # (W, W) static
+    store = pos[:, None] + offs[None, :]             # (S, W) arena offsets
+    rope = pos[:, None] + depths[None, :]            # (S, W) true positions
+    h = params["embed"][tokens]                      # (S, W, hidden)
+    if model.pos_encoding == "learned":
+        # clamp: padded rows of a near-full slot may index past the table
+        h = h + params["pos"][jnp.minimum(rope, params["pos"].shape[0] - 1)]
+    # (S, 1, W): broadcasts against (S, H, W, half) inside apply_rope
+    positions = rope[:, None, :]
+    # node j attends the committed prefix (col < pos) plus the offsets of
+    # its ancestors-or-self (col == pos + i with anc[j, i]): (S, 1, W, ctx)
+    rel = jnp.arange(ctx)[None, :] - pos[:, None]    # (S, ctx)
+    in_tree = (rel >= 0) & (rel < w)
+    anc_cols = ancm[:, jnp.clip(rel, 0, w - 1)]      # (W, S, ctx)
+    mask = ((rel < 0)[:, None, :]
+            | (in_tree[:, None, :] & jnp.moveaxis(anc_cols, 0, 1)))
+    mask = mask[:, None]                             # (S, 1, W, ctx)
+    # scatter targets: node j writes block tables[s, (pos+j) // B] at
+    # offset (pos+j) % B — same column clamp and scratch redirect as
+    # _verify_step_paged
+    rowsel = jnp.arange(s)[:, None]
+    blkcol = jnp.minimum(store // B, m - 1)
+    blk = jnp.where(offs[None, :] < n_cand[:, None],
+                    tables[rowsel, blkcol], 0)       # (S, W)
+    off = store % B
+
+    quantized = k_scale is not None
+
+    def body(carry, layer):
+        h = carry
+        if quantized:
+            bp, kc, vc, ks, vs = layer
+        else:
+            bp, kc, vc = layer      # kc/vc: (N, H, B, D) one layer
+        q, k, v = _block_qkv(model, bp, h)  # (S, H, W, D)
+        q, k = model._rope(q, k, positions)
+        if quantized:
+            kq, ksr = _kv_quantize_rows(k.transpose(0, 2, 1, 3))
+            vq, vsr = _kv_quantize_rows(v.transpose(0, 2, 1, 3))
+            kc = kc.at[blk, :, off, :].set(kq)
+            vc = vc.at[blk, :, off, :].set(vq)
+            ks = ks.at[blk, :, off].set(ksr)
+            vs = vs.at[blk, :, off].set(vsr)
+        else:
+            kc = kc.at[blk, :, off, :].set(
+                k.transpose(0, 2, 1, 3).astype(kc.dtype))
+            vc = vc.at[blk, :, off, :].set(
+                v.transpose(0, 2, 1, 3).astype(vc.dtype))
+        kg, vg = kc[tables], vc[tables]           # (S, M, H, B, D)
+        if quantized:               # dequant inside the gather
+            kg = kg.astype(jnp.float32) * ks[tables][..., None]
+            vg = vg.astype(jnp.float32) * vs[tables][..., None]
+        kg = kg.transpose(0, 2, 1, 3, 4).reshape(
+            s, mha.n_head, ctx, mha.head_dim)
+        vg = vg.transpose(0, 2, 1, 3, 4).reshape(
+            s, mha.n_head, ctx, mha.head_dim)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                            kg.astype(jnp.float32))
+        scores = scores / jnp.sqrt(jnp.float32(mha.head_dim))
+        scores = jnp.where(mask, scores, -1e30)
+        wts = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", wts, vg.astype(jnp.float32))
+        h = _finish_block(model, bp, h, o.astype(h.dtype))
+        return h, ((kc, vc, ks, vs) if quantized else (kc, vc))
+
+    if quantized:
+        h, (k_arena, v_arena, k_scale, v_scale) = lax.scan(
+            body, h, (params["blocks"], k_arena, v_arena, k_scale, v_scale))
+    else:
+        h, (k_arena, v_arena) = lax.scan(
+            body, h, (params["blocks"], k_arena, v_arena))
+    h = model._layer_norm(params["ln_f"], h)
+    logits = _head_logits(model, params, h)      # (S, W, V)
+    logits = logits.astype(jnp.float32)
+    if quantized:
+        return logits, k_arena, v_arena, k_scale, v_scale
+    return logits, k_arena, v_arena
+
+
+def _tree_commit_paged(src, pos, tables, k_arena, v_arena,
+                       k_scale=None, v_scale=None):
+    """Pointer-rewind's tree counterpart: after the host walk accepts a
+    path, copy each accepted node's k/v row from its STORE offset
+    ``pos + src[s, d-1]`` down to its POSITION offset ``pos + d`` so the
+    committed chain reads contiguously for every later step.  ``src``
+    (S, Dmax) int32 gives the accepted node index at depth d = column+1;
+    the identity ``src[s, d-1] == d`` (spine nodes, plain slots, idle
+    rows) degenerates to a same-location rewrite, so only rounds where
+    some slot accepted an ALTERNATE need to run this at all — the engine
+    skips the call otherwise.  Gathers complete before scatters
+    (functional update), so an identity row can never read a
+    half-written block."""
+    s, dmax = src.shape
+    m = tables.shape[1]
+    B = k_arena.shape[3]
+    rowsel = jnp.arange(s)[:, None]
+    src_abs = pos[:, None] + src
+    dst_abs = pos[:, None] + 1 + jnp.arange(dmax)[None, :]
+    # identity rows of a near-full slot clamp src and dst to the SAME
+    # final block column, so the clamped write is still a no-op
+    sblk = tables[rowsel, jnp.minimum(src_abs // B, m - 1)]
+    soff = src_abs % B
+    dblk = tables[rowsel, jnp.minimum(dst_abs // B, m - 1)]
+    doff = dst_abs % B
+
+    quantized = k_scale is not None
+
+    def body(carry, layer):
+        if quantized:
+            kc, vc, ks, vs = layer
+        else:
+            kc, vc = layer
+        kr = kc[sblk, :, soff, :]                 # (S, Dmax, H, D)
+        vr = vc[sblk, :, soff, :]
+        kc = kc.at[dblk, :, doff, :].set(kr)
+        vc = vc.at[dblk, :, doff, :].set(vr)
+        if quantized:
+            ksr = ks[sblk, :, soff]
+            vsr = vs[sblk, :, soff]
+            ks = ks.at[dblk, :, doff].set(ksr)
+            vs = vs.at[dblk, :, doff].set(vsr)
+            return carry, (kc, vc, ks, vs)
+        return carry, (kc, vc)
+
+    if quantized:
+        _, (k_arena, v_arena, k_scale, v_scale) = lax.scan(
+            body, 0, (k_arena, v_arena, k_scale, v_scale))
+        return k_arena, v_arena, k_scale, v_scale
+    _, (k_arena, v_arena) = lax.scan(body, 0, (k_arena, v_arena))
+    return k_arena, v_arena
 
 
 def _decode_step(model, params, token, pos, k_cache, v_cache):

@@ -430,7 +430,7 @@ class _Slot:
     __slots__ = ("stream", "pos_next", "last0", "remaining", "step_idx",
                  "temperature", "eos0", "step_keys", "last_emit_at",
                  "blocks", "table", "draft_ok", "demoted", "accept_ema",
-                 "spec_rounds", "probe_in", "rid", "replay")
+                 "spec_rounds", "probe_in", "tree_rung", "rid", "replay")
 
     def __init__(self, req: _Request, prompt_len: int, first0: int,
                  blocks: List[int], table: np.ndarray):
@@ -457,6 +457,7 @@ class _Slot:
         self.accept_ema = None          # acceptance-rate EMA
         self.spec_rounds = 0            # rounds of EMA evidence
         self.probe_in = 0               # plain rounds until re-probe
+        self.tree_rung = 0              # shape-ladder rung (tree mode)
 
 
 class KVHandoff:
@@ -653,7 +654,8 @@ class LMServingEngine:
         import jax
         from bigdl_tpu.models.transformer.generate import (
             _decode_step_paged, _insert_blocks, _prefill_parts,
-            _prefill_suffix_parts, _verify_step_paged)
+            _prefill_suffix_parts, _tree_commit_paged,
+            _tree_verify_step_paged, _verify_step_paged)
         from bigdl_tpu.quant import dequantize_entry
 
         model._built()
@@ -862,13 +864,19 @@ class LMServingEngine:
         self._verify_compiles = 0
         if spec is not None:
             from bigdl_tpu.quant import params_dtype_tag, set_compute_mode
-            from bigdl_tpu.serving.spec import (DraftModel, SpecConfig,
-                                                SpecMetrics)
+            from bigdl_tpu.serving.spec import (DraftModel, NgramDrafter,
+                                                SpecConfig, SpecMetrics)
             if isinstance(spec, int):
                 spec = SpecConfig(k=spec)
             self.spec = spec
             draft_lm = spec.draft
-            if draft_lm is None:
+            if getattr(spec, "drafter_compute", None) == "ngram":
+                # zero-model prompt-lookup drafter: host-side suffix
+                # matching, no device programs, no arena
+                self.draft = NgramDrafter(
+                    model.vocab_size, slots=self.slots,
+                    ngram_max=spec.ngram_max)
+            elif draft_lm is None:
                 # derive the default drafter: the target's int8 clone
                 # (or the target itself when it is already quantized),
                 # running the kernels spec.drafter_compute asks for —
@@ -888,16 +896,18 @@ class LMServingEngine:
                         draft_lm = draft_lm.evaluate()
                 else:
                     draft_lm = model.quantize("int8", compute=comp)
-            if draft_lm.vocab_size != model.vocab_size:
-                raise ValueError(
-                    f"draft model vocab ({draft_lm.vocab_size}) differs "
-                    f"from the target's ({model.vocab_size}): drafted "
-                    "token ids would not be the target's token ids")
-            self.draft = DraftModel(
-                draft_lm, slots=self.slots, cache_len=self.cache_len,
-                prefill_buckets=self.prefill_buckets,
-                max_cache_entries=max_cache_entries,
-                sampling=spec.sampling, placement_tag=_ptag)
+            if self.draft is None:
+                if draft_lm.vocab_size != model.vocab_size:
+                    raise ValueError(
+                        f"draft model vocab ({draft_lm.vocab_size}) "
+                        f"differs from the target's ({model.vocab_size}): "
+                        "drafted token ids would not be the target's "
+                        "token ids")
+                self.draft = DraftModel(
+                    draft_lm, slots=self.slots, cache_len=self.cache_len,
+                    prefill_buckets=self.prefill_buckets,
+                    max_cache_entries=max_cache_entries,
+                    sampling=spec.sampling, placement_tag=_ptag)
             self.spec_metrics = SpecMetrics().publish_to(get_registry())
             self.spec_metrics.compute_mode = self.draft.compute_mode
             _drep = getattr(draft_lm, "quant_report", None) or {}
@@ -923,6 +933,61 @@ class LMServingEngine:
             self._verify_jit = jax.jit(
                 _verify_fn,
                 donate_argnums=_vdonate if donate_cache else ())
+
+            if spec.tree:
+                # one donated verify executable per ladder rung: the
+                # shape's depths/ancestor matrix are static constants of
+                # each trace, so mixed-rung rounds ride the round's
+                # widest rung with per-slot n_cand truncation (every
+                # lower rung is a prefix of it)
+                self._tree_shapes = list(spec.shapes)
+
+                def _mk_tree_verify(shp):
+                    _depths = np.asarray(shp.depths, np.int32)
+                    _anc = np.ascontiguousarray(shp.anc)
+                    if _kvq:
+                        def _fn(params, tokens, pos, n_cand, tables, kc,
+                                vc, ks, vs):
+                            return _constrain(_tree_verify_step_paged(
+                                model, dequantize_entry(params), tokens,
+                                pos, n_cand, tables, kc, vc, ks, vs,
+                                depths=_depths, anc=_anc))
+                    else:
+                        def _fn(params, tokens, pos, n_cand, tables, kc,
+                                vc):
+                            return _constrain(_tree_verify_step_paged(
+                                model, dequantize_entry(params), tokens,
+                                pos, n_cand, tables, kc, vc,
+                                depths=_depths, anc=_anc))
+                    return jax.jit(
+                        _fn,
+                        donate_argnums=_vdonate if donate_cache else ())
+
+                self._verify_tree_jits = [
+                    _mk_tree_verify(s) for s in self._tree_shapes]
+                self._verify_tree_execs: dict = {}
+                # the accepted-path commit: only needed when a shape has
+                # off-spine nodes, sized to the deepest alternate depth
+                self._commit_dmax = max(
+                    (s.max_depth for s in self._tree_shapes
+                     if not s.is_chain), default=0)
+                if _kvq:
+                    def _commit_fn(src, pos, tables, kc, vc, ks, vs):
+                        return _constrain(_tree_commit_paged(
+                            src, pos, tables, kc, vc, ks, vs))
+
+                    _cdonate = (3, 4, 5, 6)
+                else:
+                    def _commit_fn(src, pos, tables, kc, vc):
+                        return _constrain(_tree_commit_paged(
+                            src, pos, tables, kc, vc))
+
+                    _cdonate = (3, 4)
+                self._commit_jit = jax.jit(
+                    _commit_fn,
+                    donate_argnums=_cdonate if donate_cache else ())
+                self._commit_exec = None
+                self._commit_compiles = 0
 
         self.metrics = (metrics if metrics is not None
                         else LMMetrics(self.slots)).publish_to(
@@ -966,7 +1031,9 @@ class LMServingEngine:
                 "params", f"{name}/staged",
                 params_nbytes(self._params), device=_dev,
                 note=f"quant={params_dtype_tag(self._params)}"))
-            if self.draft is not None:
+            if self.draft is not None and \
+                    getattr(self.draft, "k", None) is not None:
+                # (the n-gram drafter has no arena — nothing to attribute)
                 _draft_ref = _weakref.ref(self.draft)
 
                 def _draft_bytes():
@@ -1092,7 +1159,15 @@ class LMServingEngine:
             # a spec engine decodes through the verify executable (a
             # plain-decode slot is just an n_cand=1 row); the drafter
             # warms its own prefill/decode/insert programs
-            self._verify_compiled()
+            if self.spec.tree:
+                # tree mode: one executable per ladder rung, plus the
+                # accepted-path commit when any shape has alternates
+                for r in range(len(self._tree_shapes)):
+                    self._verify_tree_compiled(r)
+                if self._commit_dmax:
+                    self._commit_compiled()
+            else:
+                self._verify_compiled()
             self.draft.warmup()
         elif self.migrate is None:
             # a prefill-phase replica never decodes — its requests
@@ -1184,6 +1259,58 @@ class LMServingEngine:
             self._ledger_exec("verify", f"slots={self.slots}",
                               self._verify_exec)
         return self._verify_exec
+
+    def _verify_tree_compiled(self, rung: int):
+        """Tree mode's bounded-executables contract: one donated verify
+        per ladder rung (the shape's mask/depths are trace constants),
+        counted in ``_verify_compiles`` exactly like linear verify.  A
+        round lowers at its widest participating rung; narrower slots
+        truncate with ``n_cand``."""
+        exe = self._verify_tree_execs.get(rung)
+        if exe is None:
+            import jax
+            sh = (dict(sharding=self.placement.replicated())
+                  if self.placement is not None else {})
+            sds = jax.ShapeDtypeStruct
+            w = self._tree_shapes[rung].width
+            tok = sds((self.slots, w), np.int32, **sh)
+            pos = sds((self.slots,), np.int32, **sh)
+            ncand = sds((self.slots,), np.int32, **sh)
+            tables = sds((self.slots, self.table_width), np.int32, **sh)
+            args = [self._params, tok, pos, ncand, tables,
+                    self.pool.k, self.pool.v]
+            if self.kv_quant is not None:
+                args += [self.pool.ks, self.pool.vs]
+            exe = self._verify_tree_jits[rung].lower(*args).compile()
+            self._verify_tree_execs[rung] = exe
+            self._verify_compiles += 1
+            self._ledger_exec(
+                "verify", f"slots={self.slots}/tree_w={w}", exe)
+        return exe
+
+    def _commit_compiled(self):
+        """The accepted-path commit executable (tree mode, shapes with
+        alternates only): copies each accepted off-spine node's k/v row
+        from its store offset to its position offset.  One lowering —
+        ``src`` is always (S, Dmax) with identity rows for slots that
+        stayed on the spine."""
+        if self._commit_exec is None:
+            import jax
+            sh = (dict(sharding=self.placement.replicated())
+                  if self.placement is not None else {})
+            sds = jax.ShapeDtypeStruct
+            src = sds((self.slots, self._commit_dmax), np.int32, **sh)
+            pos = sds((self.slots,), np.int32, **sh)
+            tables = sds((self.slots, self.table_width), np.int32, **sh)
+            args = [src, pos, tables, self.pool.k, self.pool.v]
+            if self.kv_quant is not None:
+                args += [self.pool.ks, self.pool.vs]
+            self._commit_exec = self._commit_jit.lower(*args).compile()
+            self._commit_compiles += 1
+            self._ledger_exec(
+                "verify", f"slots={self.slots}/tree_commit",
+                self._commit_exec)
+        return self._commit_exec
 
     def _insert_compiled(self, bucket: int):
         exe = self._insert_execs.get(bucket)
@@ -2378,6 +2505,8 @@ class LMServingEngine:
             if st.draft_ok:
                 self.draft.admit(slot, req.prompt0)
                 self.draft.push(slot, first0)
+                if self.spec.tree:
+                    st.tree_rung = self.spec.init_rung
         with self._cv:
             self._slots[slot] = st
             self._n_active += 1
@@ -2480,6 +2609,8 @@ class LMServingEngine:
         from bigdl_tpu.serving.spec.verify import accept_row
 
         cfg = self.spec
+        if cfg.tree:
+            return self._step_spec_tree()
         mode = cfg.sampling
         # -- choose who speculates this round --------------------------- #
         jobs = {}
@@ -2540,7 +2671,7 @@ class LMServingEngine:
             if st is None:
                 continue
             active.append(i)
-            ds, _ = drafts.get(i, ((), None))
+            ds, _, _ = drafts.get(i, ((), None, ()))
             tokens[i, 0] = st.last0
             for j, d in enumerate(ds):
                 tokens[i, 1 + j] = d
@@ -2587,7 +2718,7 @@ class LMServingEngine:
                 st.step_idx += 1
                 st.remaining -= 1
                 continue
-            ds, qrows = drafts.get(i, ((), None))
+            ds, qrows, _ = drafts.get(i, ((), None, ()))
             k_eff = len(ds)
             emitted = []
             accepted = 0
@@ -2642,6 +2773,275 @@ class LMServingEngine:
                     self.draft.commit(i, accepted, emitted)
                 else:
                     self.draft.push(i, emitted[0])
+        self.spec_metrics.record_verify_round(
+            bool(jobs), n_emitted, self.draft.steps - steps_before)
+        self.metrics.record_step(len(active), itls,
+                                 prefill_interrupted=self._prefill_since_step)
+        self._prefill_since_step = False
+        if freed:
+            with self._cv:
+                for i in freed:
+                    st = self._slots[i]
+                    self._trace_done(st.stream, st.rid)
+                    self.pool.release(st.blocks)
+                    self._slots[i] = None
+                    if self.draft is not None:
+                        self.draft.release(i)
+                    self._free.append(i)
+                    self._n_active -= 1
+                self._cv.notify_all()
+
+    def _step_spec_tree(self):
+        """One TREE-speculative round (replay acceptance only): each
+        eligible slot picks a ladder rung (its adaptive ``tree_rung``,
+        clamped down so the shape fits its remaining budget), the
+        drafter proposes the spine plus ranked runner-up alternates at
+        zero extra steps, and ONE pre-lowered verify executable — the
+        round's widest participating rung, narrower slots truncated via
+        ``n_cand`` — scores every node against the paged arenas.  The
+        host then walks each slot's tree root-down, emitting the offline
+        ``pick_token`` draw at every accepted node, so the stream is the
+        exact offline trajectory whichever branch carried it.  A slot
+        that accepted an ALTERNATE has that node's k/v committed down to
+        its position offset afterwards (``_tree_commit_paged``, skipped
+        entirely on spine-only rounds); rejected rows stay as masked
+        garbage above the rewound pointer, same as linear verify.
+
+        The acceptance EMA drives three nested responses: rung
+        promotion at ``promote_above`` (speculate deeper/wider), rung
+        step-down at ``stepdown_below``, and full demotion to plain
+        decode below ``demote_below`` with the same re-probe lifecycle
+        as linear mode — a re-probed slot restarts at ``init_rung``."""
+        from bigdl_tpu.resilience.faults import fault_point
+        from bigdl_tpu.serving.spec.verify import pick_token
+
+        cfg = self.spec
+        shapes = self._tree_shapes
+        top = len(shapes) - 1
+        # -- choose who speculates, and at which rung ------------------- #
+        jobs: dict = {}
+        for i, st in enumerate(self._slots):
+            if st is None or not st.draft_ok:
+                continue
+            if st.demoted:
+                st.probe_in -= 1
+                if st.probe_in > 0:
+                    continue
+                # re-probe: forget the collapsed EMA, restart the ladder
+                st.demoted = False
+                st.accept_ema = None
+                st.spec_rounds = 0
+                st.tree_rung = cfg.init_rung
+                self.spec_metrics.record_reprobe()
+            # budget clamp: the shape stores nodes at pos .. pos+W-1 and
+            # emits at most max_depth+1 <= W tokens, so W <= remaining
+            # keeps every write and every emission inside the chain
+            # allocated at admission
+            rung = min(st.tree_rung, top)
+            while rung >= 0 and shapes[rung].width > st.remaining:
+                rung -= 1
+            if rung < 0:
+                continue        # remaining == 1: ride as a plain row
+            jobs[i] = rung
+        djobs = {}
+        for i, rung in jobs.items():
+            st = self._slots[i]
+            shp = shapes[rung]
+            keys = None
+            if st.temperature > 0.0 and st.step_keys is not None:
+                keys = st.step_keys[st.step_idx:st.step_idx + shp.spine]
+            djobs[i] = (shp.spine, st.temperature, keys, shp.alt_counts)
+        steps_before = self.draft.steps
+        drafts = self.draft.draft_round(djobs)
+
+        # same chaos site as linear verify — tree rounds demote
+        # identically: drafts discarded, round served plain, streams
+        # stay bit-exact
+        try:
+            fault_point("serving.verify", name=self.name,
+                        k=cfg.k, speculating=len(jobs), tree=True)
+        except TransientBackendError:
+            for i in jobs:
+                st = self._slots[i]
+                self.draft.commit(i, 0, [])
+                st.demoted = True
+                st.probe_in = cfg.probe_interval
+                self.spec_metrics.record_demotion(fault=True)
+                if _tracer.sampled(st.rid):
+                    _tracer.instant("lm/demote", cat="serve",
+                                    request_id=st.rid, slot=i,
+                                    reason="verify_fault")
+            drafts = {}
+            jobs = {}
+
+        # -- one verify at the round's widest rung ---------------------- #
+        round_rung = max(jobs.values(), default=0)
+        shp_round = shapes[round_rung]
+        w = shp_round.width
+        tokens = np.zeros((self.slots, w), np.int32)
+        pos = np.zeros((self.slots,), np.int32)
+        ncand = np.zeros((self.slots,), np.int32)
+        tables = np.zeros((self.slots, self.table_width), np.int32)
+        active = []
+        for i, st in enumerate(self._slots):
+            if st is None:
+                continue
+            active.append(i)
+            tokens[i, 0] = st.last0
+            pos[i] = st.pos_next
+            tables[i] = st.table
+            if i in jobs:
+                shp = shapes[jobs[i]]
+                ds, _, alts = drafts[i]
+                for j in range(1, shp.width):
+                    p = shp.parents[j]
+                    if j <= shp.spine:
+                        tokens[i, j] = ds[j - 1]
+                    else:
+                        ranked = alts[p] if p < len(alts) else ()
+                        r = shp.alt_rank[j]
+                        # an unfillable alternate keeps token 0: under
+                        # replay it accepts only if 0 IS the offline
+                        # emission, which is a legitimate accept
+                        if r < len(ranked):
+                            tokens[i, j] = ranked[r]
+                ncand[i] = shp.width
+            else:
+                ncand[i] = 1
+        if not active:
+            return
+        t0 = time.perf_counter()
+        with _tracer.span("lm/verify_step", cat="serve",
+                          active=len(active), speculating=len(jobs),
+                          tree_w=w):
+            if self.kv_quant is not None:
+                (logits, self.pool.k, self.pool.v, self.pool.ks,
+                 self.pool.vs) = self._verify_tree_compiled(round_rung)(
+                    self._params, tokens, pos, ncand, tables,
+                    self.pool.k, self.pool.v, self.pool.ks, self.pool.vs)
+            else:
+                (logits, self.pool.k,
+                 self.pool.v) = self._verify_tree_compiled(round_rung)(
+                    self._params, tokens, pos, ncand, tables,
+                    self.pool.k, self.pool.v)
+            logits = np.asarray(logits)  # sync; (S, W, V) f32
+        now = time.perf_counter()
+        if _tracer.enabled:
+            for i in active:
+                st = self._slots[i]
+                if _tracer.sampled(st.rid):
+                    _tracer.add_complete(
+                        "lm/verify_round", t0, now - t0, cat="serve",
+                        args={"request_id": st.rid, "slot": i,
+                              "step": st.step_idx,
+                              "speculating": i in jobs})
+        itls = []
+        freed = []
+        n_emitted = 0
+        commit_src = None     # lazily built: only alternate accepts move
+        for i in active:
+            st = self._slots[i]
+            if st.replay:
+                # payload-less resume riding the round as a plain row
+                st.last0 = st.replay.popleft()
+                st.pos_next += 1
+                st.step_idx += 1
+                st.remaining -= 1
+                continue
+            shp = shapes[jobs[i]] if i in jobs else None
+            emitted = []
+            node = 0
+            accepted = 0
+            spine_ok = 0
+            alt_ok = 0
+            finished = False
+            while True:
+                key = (st.step_keys[st.step_idx]
+                       if st.step_keys is not None else None)
+                e = pick_token(logits[i, node], st.temperature, key,
+                               clamp=True)
+                emitted.append(e)
+                st.stream._emit(e + 1)
+                itls.append(now - st.last_emit_at)
+                st.last_emit_at = now
+                st.last0 = e
+                st.pos_next += 1
+                st.step_idx += 1
+                st.remaining -= 1
+                if st.remaining <= 0 or (st.eos0 is not None
+                                         and e == st.eos0):
+                    finished = True
+                    break
+                nxt = None
+                if shp is not None:
+                    for c in shp.children[node]:
+                        if int(tokens[i, c]) == e:
+                            nxt = c
+                            break
+                if nxt is None:
+                    break
+                accepted += 1
+                if nxt <= shp.spine:
+                    spine_ok += 1
+                else:
+                    # the accepted path left the spine: schedule this
+                    # node's k/v copy-down (alternates are leaves, so at
+                    # most one move per slot per round)
+                    alt_ok += 1
+                    if commit_src is None:
+                        commit_src = np.tile(
+                            np.arange(1, self._commit_dmax + 1,
+                                      dtype=np.int32),
+                            (self.slots, 1))
+                    commit_src[i, accepted - 1] = nxt
+                node = nxt
+            n_emitted += len(emitted)
+            if shp is not None:
+                self.spec_metrics.record_round(shp.width - 1, accepted)
+                self.spec_metrics.record_tree_slot(
+                    shp.max_depth, shp.width, len(emitted), alt_ok)
+                rate = accepted / shp.max_depth
+                st.accept_ema = (rate if st.accept_ema is None
+                                 else cfg.ema_alpha * rate
+                                 + (1.0 - cfg.ema_alpha) * st.accept_ema)
+                st.spec_rounds += 1
+                if (not finished and st.spec_rounds >= cfg.min_rounds
+                        and st.accept_ema < cfg.demote_below):
+                    st.demoted = True
+                    st.probe_in = cfg.probe_interval
+                    self.spec_metrics.record_demotion()
+                    if _tracer.sampled(st.rid):
+                        _tracer.instant("lm/demote", cat="serve",
+                                        request_id=st.rid, slot=i,
+                                        reason="acceptance_collapse",
+                                        accept_ema=round(st.accept_ema, 4))
+                elif st.accept_ema >= cfg.promote_above:
+                    st.tree_rung = min(st.tree_rung + 1, top)
+                elif st.accept_ema < cfg.stepdown_below:
+                    st.tree_rung = max(st.tree_rung - 1, 0)
+            if finished:
+                st.stream._finish()
+                self.metrics.record_complete()
+                freed.append(i)
+            elif st.draft_ok:
+                if shp is not None:
+                    # the drafter's cache tracks only the SPINE: rewind
+                    # past accepted spine drafts, catch up on the rest
+                    self.draft.commit(i, spine_ok, emitted)
+                else:
+                    self.draft.push(i, emitted[0])
+        if commit_src is not None:
+            with _tracer.span("lm/tree_commit", cat="serve"):
+                if self.kv_quant is not None:
+                    (self.pool.k, self.pool.v, self.pool.ks,
+                     self.pool.vs) = self._commit_compiled()(
+                        commit_src, pos, tables,
+                        self.pool.k, self.pool.v,
+                        self.pool.ks, self.pool.vs)
+                else:
+                    self.pool.k, self.pool.v = self._commit_compiled()(
+                        commit_src, pos, tables,
+                        self.pool.k, self.pool.v)
         self.spec_metrics.record_verify_round(
             bool(jobs), n_emitted, self.draft.steps - steps_before)
         self.metrics.record_step(len(active), itls,
@@ -2770,6 +3170,12 @@ class LMServingEngine:
         out = self.spec.describe()
         out["demoted_slots"] = demoted
         out["draft"] = self.draft.describe()
+        out["verify_compiles"] = self._verify_compiles
+        if self.spec.tree:
+            out["commit_compiles"] = self._commit_compiles
+            with self._cv:
+                out["slot_rungs"] = [s.tree_rung if s is not None else None
+                                     for s in self._slots]
         out.update(self.spec_metrics.snapshot())
         return out
 

@@ -8,13 +8,23 @@ chain, so greedy AND sampled speculative streams stay bit-exact vs
 offline ``generate()``.  See the module docstrings of
 :mod:`.draft`, :mod:`.verify`, :mod:`.metrics`.
 
+Speculation 2.0 widens the chain to a small candidate TREE
+(``SpecConfig(tree=True)``): the drafter's spine plus ranked
+runner-up alternates are scored in one pass per pre-lowered
+:class:`TreeShape`, per-slot depth/width adapts over the shape ladder
+from the acceptance EMA, and a zero-model prompt-lookup
+:class:`NgramDrafter` (``drafter_compute="ngram"``) drafts from suffix
+matches in the request's own prompt + emitted tokens.
+
 Enable with ``LMServingEngine(model, spec=SpecConfig(k=4))``.
 """
-from bigdl_tpu.serving.spec.draft import DraftModel
+from bigdl_tpu.serving.spec.draft import DraftModel, NgramDrafter
 from bigdl_tpu.serving.spec.metrics import SpecMetrics
-from bigdl_tpu.serving.spec.verify import (SpecConfig, accept_row,
-                                           accept_walk, draft_pick,
-                                           pick_token)
+from bigdl_tpu.serving.spec.verify import (SpecConfig, TreeShape,
+                                           accept_row, accept_walk,
+                                           default_tree_shapes, draft_pick,
+                                           pick_token, tree_accept_walk)
 
-__all__ = ["DraftModel", "SpecConfig", "SpecMetrics", "accept_row",
-           "accept_walk", "draft_pick", "pick_token"]
+__all__ = ["DraftModel", "NgramDrafter", "SpecConfig", "SpecMetrics",
+           "TreeShape", "accept_row", "accept_walk", "default_tree_shapes",
+           "draft_pick", "pick_token", "tree_accept_walk"]

@@ -35,6 +35,34 @@ from bigdl_tpu.serving.compile_cache import CompileCache
 from bigdl_tpu.serving.spec.verify import draft_pick
 
 
+def _ranked_alternates(logits_row: np.ndarray, temperature: float, key,
+                       picked: int, n: int) -> List[int]:
+    """The drafter's ``n`` next-best proposals from ONE logits row —
+    the tree verifier's alternate branches, costing zero extra drafter
+    steps.  Greedy ranks raw logits; a sampled-replay row ranks the
+    chain key's Gumbel-perturbed scores (categorical IS Gumbel-argmax),
+    so alternates are that draw's runner-ups.  ``picked`` (the spine
+    draft) is excluded — an alternate duplicating the spine would be a
+    wasted verify row."""
+    z = np.asarray(logits_row, np.float64)
+    if temperature > 0.0 and key is not None:
+        import jax
+        import jax.numpy as jnp
+        t = max(temperature, 1e-6)
+        g = jax.random.gumbel(jnp.asarray(key), (z.shape[0],))
+        z = z / t + np.asarray(g, np.float64)
+    order = np.argsort(-z, kind="stable")
+    out: List[int] = []
+    for tok in order:
+        tok = int(tok)
+        if tok == int(picked):
+            continue
+        out.append(tok)
+        if len(out) >= n:
+            break
+    return out
+
+
 def _ledger_record(tag: str, key: str, compiled) -> None:
     """File a directly-lowered executable's cost/memory row (best
     effort — the ledger must never break a compile path)."""
@@ -218,22 +246,29 @@ class DraftModel:
     # -- the draft round ------------------------------------------------ #
     def draft_round(self, jobs: Dict[int, tuple]) -> Dict[int, tuple]:
         """Draft ``k_eff`` tokens for each job.  ``jobs`` maps slot ->
-        (k_eff, temperature, keys) with keys an optional (k_eff, 2)
-        uint32 chain-key slice.  Every job first catches its slot up on
-        pending emitted tokens, then autoregressively drafts; all jobs
-        advance in lockstep through ONE donated decode executable, with
-        finished/absent jobs writing the scratch row.  Returns slot ->
-        (drafts, draft_logit_rows) — logit rows kept only in rejection
-        mode, where acceptance needs q."""
+        (k_eff, temperature, keys) — or (k_eff, temperature, keys,
+        alt_counts) in tree mode, where ``alt_counts[i]`` asks for that
+        many ranked alternates off draft step i.  ``keys`` is an
+        optional (k_eff, 2) uint32 chain-key slice.  Every job first
+        catches its slot up on pending emitted tokens, then
+        autoregressively drafts; all jobs advance in lockstep through
+        ONE donated decode executable, with finished/absent jobs
+        writing the scratch row.  Returns slot -> (drafts,
+        draft_logit_rows, alternates) — logit rows kept only in
+        rejection mode, where acceptance needs q; alternates is one
+        ranked token list per draft step (empty unless requested)."""
         if not jobs:
             return {}
         state: Dict[int, dict] = {}
-        for s, (k_eff, temp, keys) in jobs.items():
+        for s, job in jobs.items():
+            k_eff, temp, keys = job[:3]
+            alt_counts = tuple(job[3]) if len(job) > 3 else ()
             st = self._st[s]
             feeds = list(st.pending)
             assert feeds, "draft_round on a slot with nothing pending"
             state[s] = {"feeds": feeds, "k": int(k_eff), "temp": temp,
                         "keys": keys, "drafts": [], "rows": [], "fed": 0,
+                        "alts": [], "alt_counts": alt_counts,
                         "total": len(feeds) + int(k_eff) - 1}
         n_steps = max(v["total"] for v in state.values())
         keep_rows = self.sampling == "rejection"
@@ -265,6 +300,11 @@ class DraftModel:
                         logits[s], v["temp"], key, self.sampling))
                     if keep_rows:
                         v["rows"].append(logits[s].copy())
+                    na = (v["alt_counts"][i]
+                          if i < len(v["alt_counts"]) else 0)
+                    v["alts"].append(_ranked_alternates(
+                        logits[s], v["temp"], key, v["drafts"][-1], na)
+                        if na > 0 else [])
         out = {}
         for s, v in state.items():
             st = self._st[s]
@@ -272,7 +312,8 @@ class DraftModel:
             st.q_next = st.draft_base + v["k"] - 1
             st.last_k = v["k"]
             st.pending = []
-            out[s] = (v["drafts"], v["rows"] if keep_rows else None)
+            out[s] = (v["drafts"], v["rows"] if keep_rows else None,
+                      v["alts"])
         return out
 
     def commit(self, slot: int, accepted: int, emitted) -> None:
@@ -295,3 +336,139 @@ class DraftModel:
                 "cache_len": self.cache_len,
                 "steps": self.steps,
                 "prefill_cache": self.prefill_cache.stats()}
+
+
+class NgramDrafter:
+    """Zero-model prompt-lookup drafter: proposals come from suffix
+    n-gram matches against the request's OWN prompt + emitted tokens —
+    the free-win regime for summarization / code-edit / RAG shapes
+    whose outputs quote their inputs.  Duck-types the ``DraftModel``
+    surface the engine drives (admit/push/commit/draft_round/release),
+    with no device programs, no arena and no drafter steps: ``steps``
+    and ``decode_compiles`` stay 0, which is exactly the point.
+
+    Correctness needs nothing from the heuristic: under replay
+    acceptance a proposed token is accepted IFF it equals the offline
+    emission, so an unmatched (filler) node simply never accepts — KV
+    written for it is garbage above the rewound pointer, same as any
+    rejected draft.  Drafting is fully deterministic (pure function of
+    the slot's token history), and every ingested token is validated
+    against the target vocab so a corrupt client id fails loudly at
+    admission instead of as an out-of-range embed gather on device."""
+
+    def __init__(self, vocab_size: int, *, slots: int, ngram_max: int = 3,
+                 max_context: int = 4096):
+        self.vocab_size = int(vocab_size)
+        self.slots = int(slots)
+        self.ngram_max = max(1, int(ngram_max))
+        # lookup window cap: suffix matching scans the whole context,
+        # so bound host work per round on very long streams
+        self.max_context = int(max_context)
+        self._ctx: List[Optional[List[int]]] = [None] * self.slots
+        self.steps = 0             # never advances: zero drafter cost
+        self.decode_compiles = 0
+        self.compute_mode = "ngram"
+        self.dtype_tag = "none"
+        self.arena_bytes = 0
+        self.sampling = "replay"
+        self.lookups = 0
+        self.hits = 0
+
+    # -- device-program surface (vacuous) ------------------------------- #
+    def warmup(self) -> int:
+        return 0
+
+    def can_draft(self, prompt_len: int) -> bool:
+        # no prefill buckets: any prompt the engine can admit is usable
+        return True
+
+    # -- per-slot lifecycle --------------------------------------------- #
+    def _checked(self, toks) -> List[int]:
+        out = []
+        for t in np.asarray(toks, dtype=np.int64).reshape(-1).tolist():
+            if not 0 <= t < self.vocab_size:
+                raise ValueError(
+                    f"ngram drafter fed token {t} outside the target "
+                    f"vocab [0, {self.vocab_size})")
+            out.append(int(t))
+        return out
+
+    def admit(self, slot: int, prompt0: np.ndarray) -> None:
+        self._ctx[slot] = self._checked(prompt0)
+
+    def push(self, slot: int, token0: int) -> None:
+        self._ctx[slot].extend(self._checked([token0]))
+
+    def commit(self, slot: int, accepted: int, emitted) -> None:
+        # the drafter attends nothing, so "catching up" is just
+        # extending the context with every emitted token
+        del accepted
+        self._ctx[slot].extend(self._checked(emitted))
+
+    def release(self, slot: int) -> None:
+        self._ctx[slot] = None
+
+    def release_all(self) -> None:
+        self._ctx = [None] * self.slots
+
+    # -- drafting ------------------------------------------------------- #
+    def _continuations(self, ctx: List[int], k: int,
+                       want: int) -> List[List[int]]:
+        """Ranked distinct continuations of the current suffix: longest
+        matching n-gram first, most recent occurrence first — the
+        prompt-lookup ranking, purely positional and deterministic."""
+        out: List[List[int]] = []
+        seen = set()
+        L = len(ctx)
+        for n in range(min(self.ngram_max, L - 1), 0, -1):
+            pat = tuple(ctx[L - n:])
+            for s in range(L - n - 1, -1, -1):
+                if tuple(ctx[s:s + n]) == pat:
+                    cont = ctx[s + n:s + n + k]
+                    if cont and tuple(cont) not in seen:
+                        seen.add(tuple(cont))
+                        out.append(cont)
+                        if len(out) >= want:
+                            return out
+        return out
+
+    def draft_round(self, jobs: Dict[int, tuple]) -> Dict[int, tuple]:
+        out = {}
+        for s, job in jobs.items():
+            k_eff = int(job[0])
+            alt_counts = tuple(job[3]) if len(job) > 3 else ()
+            ctx = self._ctx[s][-self.max_context:]
+            self.lookups += 1
+            want = 1 + (max(alt_counts) if alt_counts else 0)
+            conts = self._continuations(ctx, k_eff, want)
+            if conts:
+                self.hits += 1
+            # spine: best continuation, padded with the last context
+            # token (a decent prior for degenerate/looping tails; a
+            # wrong filler costs nothing under replay acceptance)
+            filler = ctx[-1]
+            spine = list(conts[0]) if conts else []
+            spine += [filler] * (k_eff - len(spine))
+            alts: List[List[int]] = []
+            for i in range(k_eff):
+                na = alt_counts[i] if i < len(alt_counts) else 0
+                ranked: List[int] = []
+                for c in conts[1:]:
+                    if len(ranked) >= na:
+                        break
+                    if i < len(c) and c[i] != spine[i] \
+                            and c[i] not in ranked:
+                        ranked.append(c[i])
+                alts.append(ranked)
+            out[s] = (spine, None, alts)
+        return out
+
+    # -- reading -------------------------------------------------------- #
+    def describe(self) -> dict:
+        return {"dtype_tag": self.dtype_tag,
+                "compute_mode": self.compute_mode,
+                "ngram_max": self.ngram_max,
+                "steps": self.steps,
+                "lookups": self.lookups,
+                "hit_rate": (self.hits / self.lookups
+                             if self.lookups else 0.0)}

@@ -32,6 +32,14 @@ class SpecMetrics:
         self.compute_mode = "f32"
         self.overflow_risk = 0.0
         self.acceptance = Histogram()  # per-(slot, round) acceptance rate
+        # tree-verify shape telemetry: what the per-slot adaptive policy
+        # actually chose, and what each choice earned
+        self.tree_rounds = 0      # (slot, round) pairs verified as a tree
+        self.alt_accepts = 0      # accepted ALTERNATE (off-spine) nodes
+        self.tree_depth = Histogram()    # chosen shape max_depth per slot
+        self.tree_width = Histogram()    # chosen shape width per slot
+        self.accepted_per_step = Histogram()  # tokens emitted per
+        #                                       (slot, verify round)
 
     def publish_to(self, registry,
                    prefix: str = "serving/lm/spec/") -> "SpecMetrics":
@@ -51,6 +59,20 @@ class SpecMetrics:
             replace=True)
         registry.register(prefix + "acceptance", self.acceptance,
                           replace=True)
+        for key in ("tree_rounds", "alt_accepts"):
+            registry.register(prefix + key,
+                              FnGauge(lambda k=key: getattr(self, k)),
+                              replace=True)
+        registry.register(
+            prefix + "accepted_per_verify_step",
+            FnGauge(lambda: self.snapshot()["accepted_per_verify_step"]),
+            replace=True)
+        registry.register(prefix + "tree_depth", self.tree_depth,
+                          replace=True)
+        registry.register(prefix + "tree_width", self.tree_width,
+                          replace=True)
+        registry.register(prefix + "accepted_per_step",
+                          self.accepted_per_step, replace=True)
         registry.register(prefix + "compute_mode",
                           FnGauge(lambda: self.compute_mode), replace=True)
         registry.register(prefix + "overflow_risk",
@@ -76,6 +98,18 @@ class SpecMetrics:
                 self.spec_rounds += 1
             self.emitted += emitted
             self.draft_steps += draft_steps
+
+    def record_tree_slot(self, depth: int, width: int,
+                         emitted: int, alt_accepted: int) -> None:
+        """One slot's tree-round choice and outcome: the shape it rode
+        (max depth / width after budget clamping) and what it earned
+        (tokens emitted this round, off-spine nodes accepted)."""
+        with self._lock:
+            self.tree_rounds += 1
+            self.alt_accepts += alt_accepted
+            self.tree_depth.observe(depth)
+            self.tree_width.observe(width)
+            self.accepted_per_step.observe(emitted)
 
     def record_demotion(self, fault: bool = False) -> None:
         with self._lock:
@@ -108,5 +142,13 @@ class SpecMetrics:
                 "draft_overhead":
                     (self.draft_steps / self.emitted)
                     if self.emitted else None,
+                "accepted_per_verify_step":
+                    (self.emitted / self.verify_rounds)
+                    if self.verify_rounds else None,
+                "tree_rounds": self.tree_rounds,
+                "alt_accepts": self.alt_accepts,
                 "acceptance": self.acceptance.snapshot(),
+                "tree_depth": self.tree_depth.snapshot(),
+                "tree_width": self.tree_width.snapshot(),
+                "accepted_per_step": self.accepted_per_step.snapshot(),
             }

@@ -61,6 +61,7 @@ if [ "$SMOKE" = "1" ]; then
   SCAN_ITERS=1; SCAN_STEPS=2
   SERVE_LM_ARGS="--requests 6 --slots 2 --cache-len 64 --mean-gap-ms 5 --probes 1"
   SPEC_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1"
+  SPEC2_ARGS="--requests 4 --slots 2 --cache-len 64 --spec-k 2 --ngram-k 4 --mean-gap-ms 5 --probes 1"
   QCOMPUTE_ARGS="--requests 6 --slots 2 --cache-len 64 --spec-k 2 --mean-gap-ms 5 --probes 1 --duel-iters 2"
   KVTIER_ARGS="--probes 2 --slots 2 --cache-len 64 --block-len 8 --sessions 6 --rounds 2 --timing-samples 3"
   ROUTER_ARGS="--sessions 3 --turns 2 --slots 2 --cache-len 256 --block-len 8 --max-new 8 --prompt-blocks 16"
@@ -92,6 +93,7 @@ else
   SCAN_ITERS=3; SCAN_STEPS=8
   SERVE_LM_ARGS="--requests 48 --slots 8 --cache-len 128"
   SPEC_ARGS="--requests 24 --slots 8 --cache-len 128"
+  SPEC2_ARGS="--requests 16 --slots 8 --cache-len 128"
   QCOMPUTE_ARGS="--requests 24 --slots 8 --cache-len 128"
   KVTIER_ARGS=""
   ROUTER_ARGS=""
@@ -136,7 +138,7 @@ PYEOF
 ARTIFACTS="BENCH_LAST.json BENCH_SMOKE.json BENCH_SCAN.json \
 BENCH_ATTN.json TUNE_ATTN.json BENCH_LM.json BENCH_PIPELINE.json \
 BENCH_LM_SERVE.json BENCH_PREFIX.json BENCH_SLO.json BENCH_MESH.json \
-BENCH_SPEC.json BENCH_DISAGG.json BENCH_QCOMPUTE.json \
+BENCH_SPEC.json BENCH_SPEC2.json BENCH_DISAGG.json BENCH_QCOMPUTE.json \
 BENCH_KVTIER.json BENCH_ROUTER.json BENCH_DEADLINE.json \
 PROFILE_MEM.json \
 flight/FLIGHT_*.json TRACE_*.json \
@@ -339,6 +341,30 @@ spec_stage() {
   fi
   say "stage spec: not done (rc=$rc)"
   record_incident spec "$rc"
+  return 1
+}
+
+# spec2 rides right after spec: the Speculation 2.0 duels (adaptive
+# token-tree verify vs fixed linear-k at equal budget, zero-model
+# prompt lookup vs model drafting on the copy trace) over the same
+# decode hot path — on a real chip the per-rung donated tree verify
+# executables and the accepted-path commit scatter become MXU
+# evidence, and the accepted-per-verify-step deltas measure actual
+# device rounds saved.  Params stay ~1 MB, far below the 32 MB relay
+# ceiling.  Same ok_lm gate (the committed CPU BENCH_SPEC2.json must
+# never mark the TPU stage done) and the same never-gates-the-round
+# contract.
+spec2_stage() {
+  ok_lm BENCH_SPEC2.json && return 0
+  say "stage spec2: firing (budget 600s): python -u bench.py --serve-lm --spec2 $SPEC2_ARGS"
+  timeout 600 python -u bench.py --serve-lm --spec2 $SPEC2_ARGS >> "$LOG" 2>&1
+  local rc=$?
+  if ok_lm BENCH_SPEC2.json; then
+    say "stage spec2: DONE"
+    return 0
+  fi
+  say "stage spec2: not done (rc=$rc)"
+  record_incident spec2 "$rc"
   return 1
 }
 
@@ -607,6 +633,7 @@ while :; do
     autotune_stage
     serve_lm_stage
     spec_stage
+    spec2_stage
     qcompute_stage
     kvtier_stage
     router_stage

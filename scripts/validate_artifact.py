@@ -144,6 +144,55 @@ def _spec_problems(doc) -> list:
     return probs
 
 
+def _spec2_problems(doc) -> list:
+    """BENCH_SPEC2.json extras: the Speculation 2.0 duel is only
+    evidence when EVERY arm streamed the offline trajectory
+    (agreement exactly 1.0 per row) and carries a numeric
+    accepted-tokens-per-verify-step — the equal-budget comparison
+    metric — plus a verify-executable count matching its ladder (the
+    bounded-compile contract the tree rides on)."""
+    probs = []
+    if doc.get("error"):
+        return probs
+    for i, r in enumerate(doc.get("rows", [])):
+        if not isinstance(r, dict):
+            continue
+        if "stage" not in r:
+            probs.append("spec2 row %d lacks a 'stage' key" % i)
+        if doc.get("complete") is True:
+            if r.get("agreement") != 1.0:
+                probs.append("complete spec2 artifact: row %d (%s) "
+                             "agreement must be exactly 1.0, got %r"
+                             % (i, r.get("stage"), r.get("agreement")))
+            aps = r.get("accepted_per_verify_step")
+            if not isinstance(aps, (int, float)):
+                probs.append("complete spec2 artifact: row %d (%s) "
+                             "lacks numeric accepted_per_verify_step"
+                             % (i, r.get("stage")))
+            if r.get("verify_compiles") != r.get(
+                    "expected_verify_compiles"):
+                probs.append("complete spec2 artifact: row %d (%s) "
+                             "verify_compiles %r != expected %r (one "
+                             "donated executable per ladder rung)"
+                             % (i, r.get("stage"), r.get("verify_compiles"),
+                                r.get("expected_verify_compiles")))
+    if doc.get("complete") is True:
+        summ = doc.get("summary")
+        if not isinstance(summ, dict):
+            probs.append("complete spec2 artifact lacks a summary")
+            return probs
+        tb = summ.get("tree_beats_linear")
+        if not isinstance(tb, dict) or not any(tb.values()):
+            probs.append("complete spec2 artifact: "
+                         "summary.tree_beats_linear must hold on >= 1 "
+                         "trace family, got %r" % (tb,))
+        if summ.get("ngram_beats_model") is not True:
+            probs.append("complete spec2 artifact: "
+                         "summary.ngram_beats_model must be true, got %r"
+                         % (summ.get("ngram_beats_model"),))
+    return probs
+
+
 def _disagg_problems(doc) -> list:
     """BENCH_DISAGG.json extras: the disaggregated-serving proof is an
     AGREEMENT artifact — every stage must stream the exact co-located
@@ -486,6 +535,8 @@ def _problems(doc, name: str = "") -> list:
             probs.extend(_mesh_problems(doc))
         if name == "BENCH_SPEC.json":
             probs.extend(_spec_problems(doc))
+        if name == "BENCH_SPEC2.json":
+            probs.extend(_spec2_problems(doc))
         if name == "BENCH_DISAGG.json":
             probs.extend(_disagg_problems(doc))
         if name == "BENCH_QCOMPUTE.json":
